@@ -1,0 +1,29 @@
+"""dint_tpu — a TPU-native distributed transaction-processing framework.
+
+Re-implements the capabilities of DINT (NSDI'24, "Fast In-Kernel Distributed
+Transactions with eBPF", reference at /root/reference) with a TPU-first
+design: lock tables, version tables, KV tables, and replication logs live in
+HBM as JAX arrays, and batched kernels *certify* thousands of in-flight
+transaction RPCs per device step (2PL shared/exclusive grants, FaSST-style
+OCC version checks, write-set installs, log appends, 2PC votes).
+
+Where the reference answers one packet at a time inside an XDP hook with CAS
+spinlocks (store/ebpf/store_kern.c:62-67), this framework answers a *batch*
+per step: requests are sorted by key, per-key conflicts are resolved with
+closed-form segmented reductions that are serial-equivalent to the reference's
+per-packet processing, and table updates are one-writer-per-key scatters.
+
+Layout:
+  ops/       sort/segment primitives, 64-bit key handling, hashing
+  tables/    HBM-resident table engines (KV hash table, lock arrays, log rings)
+  engines/   per-workload batched server state machines
+             (store, lock_2pl, lock_fasst, log_server, smallbank, tatp)
+  proto/     wire format (reference-compatible `struct message`) + codes
+  host/      transports: loopback (in-process), UDP pump, native C++ pump
+  clients/   transaction coordinators + workload generators
+  parallel/  multi-chip sharding (Mesh/shard_map, ICI collectives, replication)
+  testing/   sequential oracles for differential testing
+  bench/     fused on-device benchmark drivers + sweep harness
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,62 @@
+"""lock_fasst: batched FaSST-style OCC lock/version server.
+
+TPU equivalent of the reference's OCC primitives in XDP
+(lock_fasst/ebpf/ls_kern.c:58-97): READ -> return version; ACQUIRE_LOCK ->
+CAS; COMMIT -> ver++, unlock; ABORT -> unlock. Userspace twin with
+locks[]+ver_table[] arrays at lock_fasst/caladan/server.cc:30-92.
+
+Batch serialization contract: per slot, commits/aborts (unlocks) first,
+then reads (which therefore see post-commit versions), then lock acquires
+in lane order — first acquirer wins a free lock, the rest are rejected.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ops import segments
+from ..tables import locks
+from .types import Batch, Op, Replies, Reply
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+
+def step(table: locks.OCCTable, batch: Batch):
+    r = batch.width
+    slot = locks.lock_slot(batch.key_hi, batch.key_lo, table.n_slots)
+    sb = segments.sort_batch(jnp.zeros((r,), U32), slot.astype(U32))
+    op = batch.op[sb.perm]
+    s_slot = slot[sb.perm]
+
+    locked0 = table.locked[s_slot]
+    ver0 = table.ver[s_slot]
+
+    is_commit = op == Op.COMMIT_VER
+    is_abort = op == Op.ABORT
+    is_read = op == Op.READ_VER
+    is_lock = op == Op.LOCK
+
+    n_commits = segments.seg_sum(sb, is_commit.astype(I32))
+    unlock_any = segments.seg_any(sb, is_commit | is_abort)
+    ver1 = ver0 + n_commits.astype(U32)
+    locked1 = locked0 & ~unlock_any
+
+    first_lock = segments.first_rank_where(sb, is_lock)
+    grant = is_lock & ~locked1 & (sb.rank == first_lock)
+    new_locked = locked1 | segments.seg_any(sb, grant)
+
+    rtype = jnp.full((r,), Reply.NONE, I32)
+    rtype = jnp.where(is_commit | is_abort, Reply.ACK, rtype)
+    rtype = jnp.where(is_read, Reply.VAL, rtype)
+    rtype = jnp.where(is_lock, jnp.where(grant, Reply.GRANT, Reply.REJECT), rtype)
+    rver = jnp.where(is_read, ver1, U32(0))
+
+    touched = op != Op.NOP
+    writer = sb.last & segments.seg_any(sb, touched)
+    table = table.replace(
+        locked=segments.scatter_rows(table.locked, s_slot, new_locked, writer),
+        ver=segments.scatter_rows(table.ver, s_slot, ver1, writer),
+    )
+    o_rtype, o_rver = segments.unsort(sb, rtype, rver)
+    zeros = jnp.zeros((r, batch.val.shape[1]), U32)
+    return table, Replies(rtype=o_rtype, val=zeros, ver=o_rver)

@@ -1,0 +1,72 @@
+"""lock_2pl: batched no-wait S/X lock server.
+
+TPU equivalent of the reference's 2PL lock server (XDP state machine at
+lock_2pl/ebpf/ls_kern.c:33-110: CAS entry spinlock, then grant/reject by
+num_sh/num_ex counters; userspace twin lock_2pl/caladan/server.cc:39-105).
+
+Batch serialization contract (same closed form the oracle implements):
+per lock slot, releases apply first, then acquires in lane order. Since
+no-wait 2PL never blocks, the sequential acquire outcome has a closed form:
+  * ex held after releases        -> reject every acquire
+  * sh held after releases        -> grant all S, reject all X
+  * free: earliest acquire is X   -> grant exactly that X, reject the rest
+  * free: earliest acquire is S   -> grant all S, reject all X
+RETRY (spinlock busy, lock_2pl/caladan/server.cc:51-57) is never emitted.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ops import segments
+from ..tables import locks
+from .types import Batch, Op, Replies, Reply
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+
+def step(table: locks.SXLockTable, batch: Batch):
+    r = batch.width
+    slot = locks.lock_slot(batch.key_hi, batch.key_lo, table.n_slots)
+    sb = segments.sort_batch(jnp.zeros((r,), U32), slot.astype(U32))
+    op = batch.op[sb.perm]
+    s_slot = slot[sb.perm]
+
+    sh0 = table.num_sh[s_slot]
+    ex0 = table.num_ex[s_slot]
+
+    is_acq_s = op == Op.ACQ_S
+    is_acq_x = op == Op.ACQ_X
+    is_acq = is_acq_s | is_acq_x
+    rel_s = segments.seg_sum(sb, (op == Op.REL_S).astype(I32))
+    rel_x = segments.seg_sum(sb, (op == Op.REL_X).astype(I32))
+    sh1 = jnp.maximum(sh0 - rel_s, 0)
+    ex1 = jnp.maximum(ex0 - rel_x, 0)
+
+    first_acq = segments.first_rank_where(sb, is_acq)
+    pos_first = jnp.clip(sb.head_pos + first_acq, 0, r - 1)
+    first_is_x = is_acq_x[pos_first] & (first_acq < (1 << 30))
+    x_takes = first_is_x & (sh1 == 0) & (ex1 == 0)
+
+    grant_x = is_acq_x & x_takes & (sb.rank == first_acq)
+    grant_s = is_acq_s & (ex1 == 0) & ~x_takes
+    granted = grant_s | grant_x
+
+    n_grant_s = segments.seg_sum(sb, grant_s.astype(I32))
+    n_grant_x = segments.seg_sum(sb, grant_x.astype(I32))
+    new_sh = sh1 + n_grant_s
+    new_ex = ex1 + n_grant_x
+
+    rtype = jnp.full((r,), Reply.NONE, I32)
+    rtype = jnp.where((op == Op.REL_S) | (op == Op.REL_X), Reply.ACK, rtype)
+    rtype = jnp.where(is_acq, jnp.where(granted, Reply.GRANT, Reply.REJECT), rtype)
+
+    touched = (op != Op.NOP)
+    writer = sb.last & segments.seg_any(sb, touched)
+    table = table.replace(
+        num_sh=segments.scatter_rows(table.num_sh, s_slot, new_sh, writer),
+        num_ex=segments.scatter_rows(table.num_ex, s_slot, new_ex, writer),
+    )
+    o_rtype = segments.unsort(sb, rtype)
+    zeros = jnp.zeros((r, batch.val.shape[1]), U32)
+    return table, Replies(rtype=o_rtype, val=zeros, ver=jnp.zeros((r,), U32))

@@ -1,0 +1,26 @@
+"""log_server: batched replication-log append server.
+
+TPU equivalent of the reference's in-XDP log append
+(log_server/ebpf/ls_kern.c:40-78: parse, pick per-CPU ring, append, ACK).
+Appends land in multi-lane HBM rings (tables.log); a batch's appends are a
+single conflict-free scatter.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..tables import log as logring
+from .types import Batch, Op, Replies, Reply
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+
+def step(ring: logring.LogRing, batch: Batch):
+    do = batch.op == Op.LOG_APPEND
+    is_del = jnp.zeros_like(batch.op)
+    ring, _, _ = logring.append(ring, do, batch.table, is_del,
+                                batch.key_hi, batch.key_lo, batch.ver, batch.val)
+    rtype = jnp.where(do, I32(Reply.ACK), I32(Reply.NONE))
+    return ring, Replies(rtype=rtype, val=jnp.zeros_like(batch.val),
+                         ver=jnp.zeros_like(batch.ver))

@@ -1,0 +1,141 @@
+"""store: batched KV server engine (GET/SET/INSERT/DELETE).
+
+TPU equivalent of the reference's store servers — the XDP fast path
+(store/ebpf/store_kern.c:32-300: parse, hash, CAS entry lock, slot scan,
+reply) and the userspace KVS fallback (store/ebpf/kvs.h) — collapsed into one
+batched state machine over an HBM-resident table that holds the whole
+keyspace.
+
+Batch semantics (the serialization contract, also implemented by the
+sequential oracle in dint_tpu.testing.oracle):
+  * Per key, a batch is processed as: all GETs first (they see pre-batch
+    state), then writes in arrival (lane) order. This is a valid serial
+    order; clients cannot distinguish it from the reference's
+    packet-arrival interleaving.
+  * SET/INSERT are upserts; each bumps the version by 1. DELETE invalidates.
+  * Replies: GET -> VAL(val, ver) or NOT_EXIST; SET/INSERT -> ACK(new ver);
+    DELETE -> ACK or NOT_EXIST; bucket overflow on insert -> SPILL (the host
+    overflow store takes the key; the reference instead runs an
+    eviction/miss protocol through userspace, store/ebpf/store_kern.c:208-246).
+  * RETRY (reference entry-spinlock busy) is never emitted: there are no
+    spinlocks to lose.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import hashing, segments
+from ..tables import kv
+from .types import Batch, Op, Replies, Reply
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+
+def step(table: kv.KVTable, batch: Batch, *, maintain_bloom: bool = False):
+    """One server step: certify and apply a batch. Returns (table', replies).
+
+    ``maintain_bloom`` (static) keeps per-bucket bloom filters exact across
+    inserts/deletes. The full-table fast path doesn't need them (probe() is
+    exact); they exist for cache-mode parity with the reference's negative
+    lookups (store/ebpf/store_kern.c:88-95) and cost a hash per slot per
+    touched bucket, so they're off by default.
+    """
+    r = batch.width
+    sb = segments.sort_batch(batch.key_hi, batch.key_lo)
+    op = batch.op[sb.perm]
+    val_in = batch.val[sb.perm]
+
+    bkt = hashing.bucket(sb.key_hi, sb.key_lo, table.n_buckets)
+    hit0, slot0, val0, ver0 = kv.probe(table, sb.key_hi, sb.key_lo, bkt)
+
+    is_get = op == Op.GET
+    is_install = (op == Op.SET) | (op == Op.INSERT)
+    is_delete = op == Op.DELETE
+    is_write = is_install | is_delete
+
+    n_inst_before = segments.seg_cumsum_excl(sb, is_install.astype(I32))
+    n_inst_total = segments.seg_sum(sb, is_install.astype(I32))
+    last_w_rank = segments.seg_max_where(sb, is_write, sb.rank, I32(-1))
+    pos_last = jnp.clip(sb.head_pos + last_w_rank, 0, r - 1)
+    last_is_del = is_delete[pos_last]
+    last_val = val_in[pos_last]
+
+    ver0_eff = jnp.where(hit0, ver0, U32(0))
+    any_write = last_w_rank >= 0
+    final_exists = jnp.where(any_write, ~last_is_del, hit0)
+    final_ver = ver0_eff + n_inst_total.astype(U32)
+
+    # ---- replies (sorted space) -------------------------------------------
+    # exact sequential existence at each write's point: the latest write
+    # before me in my segment decides, else pre-batch state
+    idx = jnp.arange(r, dtype=I32)
+    w_pos = jax.lax.cummax(jnp.where(is_write, idx, I32(-1)))
+    prev_w_pos = jnp.concatenate([jnp.full((1,), -1, I32), w_pos[:-1]])
+    in_seg = prev_w_pos >= sb.head_pos
+    existed_here = jnp.where(in_seg, is_install[jnp.clip(prev_w_pos, 0, r - 1)], hit0)
+    rtype = jnp.full((r,), Reply.NONE, I32)
+    rtype = jnp.where(is_get, jnp.where(hit0, Reply.VAL, Reply.NOT_EXIST), rtype)
+    rtype = jnp.where(is_install, Reply.ACK, rtype)
+    rtype = jnp.where(is_delete,
+                      jnp.where(existed_here, Reply.ACK, Reply.NOT_EXIST), rtype)
+    rval = jnp.where(is_get[:, None] & hit0[:, None], val0, jnp.zeros_like(val0))
+    rver = jnp.where(is_get, jnp.where(hit0, ver0, U32(0)), U32(0))
+    rver = jnp.where(is_install, ver0_eff + (n_inst_before + 1).astype(U32), rver)
+
+    # ---- writer election: segment-last lane acts for its key -------------
+    writer = sb.last & any_write
+    w_upd = writer & final_exists & hit0
+    w_alloc = writer & final_exists & ~hit0
+    w_del = writer & ~final_exists & hit0
+
+    # back to original order for phase B + scatters
+    (o_upd, o_alloc, o_del, o_bkt, o_slot0, o_ver) = segments.unsort(
+        sb, w_upd, w_alloc, w_del, bkt, slot0, final_ver)
+    o_val = segments.unsort(sb, last_val)
+    o_khi, o_klo = segments.unsort(sb, sb.key_hi, sb.key_lo)
+
+    # ---- phase B: slot allocation for inserts, per destination bucket ----
+    sb2 = segments.sort_batch(jnp.zeros((r,), U32), o_bkt.astype(U32))
+    alloc2 = o_alloc[sb2.perm]
+    rank_alloc = segments.seg_cumsum_excl(sb2, alloc2.astype(I32))
+    bkt2 = o_bkt[sb2.perm]
+    has2, slot_new2 = kv.nth_free_slot(table.valid[bkt2], rank_alloc)
+    ok2 = alloc2 & has2
+    spill2 = alloc2 & ~has2
+    ok, spill, slot_new = segments.unsort(sb2, ok2, spill2, slot_new2)
+
+    # spill => every install of that key failed: fix up replies for the whole
+    # key segment (installs -> SPILL, deletes -> NOT_EXIST since nothing was
+    # ever installed; GETs already answered from pre-state)
+    seg_spill = segments.seg_any(sb, spill[sb.perm])
+    rtype = jnp.where(seg_spill & is_install, I32(Reply.SPILL), rtype)
+    rtype = jnp.where(seg_spill & is_delete, I32(Reply.NOT_EXIST), rtype)
+    rver = jnp.where(seg_spill & is_install, U32(0), rver)
+
+    # ---- scatters (one writer per (bucket, slot); identical-value aliasing
+    # only for bloom recompute) --------------------------------------------
+    nb = table.n_buckets
+    w_any_slot = o_upd | ok | o_del
+    t_slot = jnp.where(o_upd | o_del, o_slot0, slot_new)
+    safe_b = jnp.where(w_any_slot, o_bkt, nb)
+    new_valid = table.valid.at[safe_b, t_slot].set(~o_del, mode="drop")
+    wv = (o_upd | ok)
+    safe_bv = jnp.where(wv, o_bkt, nb)
+    sl_v = jnp.where(o_upd, o_slot0, slot_new)
+    table = table.replace(
+        key_hi=table.key_hi.at[safe_bv, sl_v].set(o_khi, mode="drop"),
+        key_lo=table.key_lo.at[safe_bv, sl_v].set(o_klo, mode="drop"),
+        val=table.val.at[safe_bv, sl_v].set(o_val, mode="drop"),
+        ver=table.ver.at[safe_bv, sl_v].set(o_ver, mode="drop"),
+        valid=new_valid,
+    )
+    if maintain_bloom:
+        # recompute exactly for buckets whose membership changed
+        table = kv.recompute_bloom(table, o_bkt, ok | o_del)
+
+    o_rtype, o_rver = segments.unsort(sb, rtype, rver)
+    o_rval = segments.unsort(sb, rval)
+    replies = Replies(rtype=o_rtype, val=o_rval, ver=o_rver)
+    return table, replies

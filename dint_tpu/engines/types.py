@@ -1,0 +1,130 @@
+"""Batch/reply containers and op/reply codes shared by all server engines.
+
+A server engine is a pure function ``step(state, batch) -> (state, replies)``
+over fixed-shape arrays — the batched equivalent of the reference's
+per-packet XDP state machine `(request_packet, table_state) ->
+(reply_packet, table_state')` (e.g. /root/reference/tatp/ebpf/shard_kern.c:111).
+
+Batches are fixed width R; unused lanes carry ``op == NOP`` and
+``key == PAD_KEY``. Request arrival order is the lane index — intra-batch
+conflict resolution is serial-equivalent to processing lanes in index order
+(per key), see dint_tpu.ops.segments.
+"""
+from __future__ import annotations
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+# Reserved key for padding lanes (never a legal application key).
+PAD_KEY = 0xFFFFFFFFFFFFFFFF
+
+
+class Op:
+    """Request op codes (superset across engines; each engine uses a subset)."""
+    NOP = 0
+    # store / KV
+    GET = 1
+    SET = 2
+    INSERT = 3
+    DELETE = 4
+    # 2PL lock server (lock_2pl/ebpf/ls_kern.c:33-110)
+    ACQ_S = 5
+    ACQ_X = 6
+    REL_S = 7
+    REL_X = 8
+    # FaSST OCC (lock_fasst/ebpf/ls_kern.c:58-97)
+    READ_VER = 9
+    LOCK = 10
+    COMMIT_VER = 11
+    ABORT = 12
+    # log server (log_server/ebpf/ls_kern.c:40-78)
+    LOG_APPEND = 13
+    # txn engines (smallbank/tatp): fused lock+read / commit pipeline ops,
+    # mirroring smallbank/ebpf/shard_kern.c:96-666 & tatp/ebpf/shard_kern.c:140-939
+    ACQ_S_READ = 14    # acquire shared + read value in one RTT
+    ACQ_X_READ = 15    # acquire exclusive + read value in one RTT
+    OCC_READ = 16      # read value + version (no lock)
+    OCC_LOCK = 17      # CAS row lock
+    COMMIT_PRIM = 18   # install value, ver++, release row lock
+    COMMIT_BCK = 19    # install value+ver on backup replica
+    COMMIT_LOG = 20    # append to replication log
+    INSERT_PRIM = 21
+    DELETE_PRIM = 22
+    INSERT_BCK = 23
+    DELETE_BCK = 24
+    DELETE_LOG = 25
+
+
+class Reply:
+    """Reply codes; names follow the reference's packet-type enums
+    (smallbank/caladan/proto.h:14-37, tatp/udp/net.h:15-52)."""
+    NONE = 0
+    GRANT = 1          # lock granted (carries value for fused lock+read)
+    REJECT = 2         # no-wait lock reject / OCC lock busy
+    RETRY = 3          # reference-only (entry spinlock busy); never emitted on TPU
+    ACK = 4            # release/commit/log/set ack
+    NOT_EXIST = 5      # bloom-negative / missing key
+    VAL = 6            # read reply carrying value+version
+    SPILL = 7          # bucket overflow: host must take over this key
+
+
+@flax.struct.dataclass
+class Batch:
+    """A fixed-width batch of requests (struct-of-arrays).
+
+    Mirrors `struct message` fields {ord, type, table, key, val, ver}
+    (tatp/ebpf/utils.h:80-87); `ord` is implicit as the lane index.
+    """
+    op: jax.Array       # i32 [R]
+    table: jax.Array    # i32 [R] (table id for multi-table engines)
+    key_hi: jax.Array   # u32 [R]
+    key_lo: jax.Array   # u32 [R]
+    val: jax.Array      # u32 [R, VW]
+    ver: jax.Array      # u32 [R]
+
+    @property
+    def width(self):
+        return self.op.shape[0]
+
+
+@flax.struct.dataclass
+class Replies:
+    rtype: jax.Array    # i32 [R]
+    val: jax.Array      # u32 [R, VW]
+    ver: jax.Array      # u32 [R]
+
+
+def make_batch(ops, keys, vals=None, vers=None, tables=None, width=None,
+               val_words: int = 10) -> Batch:
+    """Host-side batch builder (numpy in, pytree of jnp out), with padding."""
+    from ..ops import u64
+
+    ops = np.asarray(ops, np.int32)
+    keys = np.asarray(keys, np.uint64)
+    r = len(ops)
+    width = width or r
+    assert width >= r
+    pad = width - r
+
+    def _pad(x, fill=0):
+        if pad == 0:
+            return x
+        shape = (pad,) + x.shape[1:]
+        return np.concatenate([x, np.full(shape, fill, x.dtype)])
+
+    ops = _pad(ops)
+    keys = _pad(keys, PAD_KEY)
+    hi, lo = u64.split(keys)
+    if vals is None:
+        vals = np.zeros((r, val_words), np.uint32)
+    vals = _pad(np.asarray(vals, np.uint32))
+    vers = _pad(np.asarray(vers if vers is not None else np.zeros(r), np.uint32))
+    tables = _pad(np.asarray(tables if tables is not None else np.zeros(r), np.int32))
+    return Batch(op=jnp.asarray(ops), table=jnp.asarray(tables),
+                 key_hi=jnp.asarray(hi), key_lo=jnp.asarray(lo),
+                 val=jnp.asarray(vals), ver=jnp.asarray(vers))

@@ -1,0 +1,1 @@
+from . import u64, hashing, segments  # noqa: F401

@@ -1,0 +1,91 @@
+"""Jittable hashing for key -> bucket / lock-slot / bloom-bit mapping.
+
+The reference uses fasthash64 for all three roles
+(/root/reference/store/ebpf/utils.h:120-168: key->bucket, key->lock unit,
+top-6-bits->bloom bit). We do not need the identical hash — servers own their
+tables — but we do need the same *roles*. The device hash here is the full
+fasthash64 finalizer structure re-expressed on (hi, lo) uint32 pairs so host
+(numpy uint64) and device (uint32 pairs) agree bit-for-bit, which lets host
+shims pre-compute shard routing while device kernels recompute bucket indices
+locally.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import u64
+from .u64 import U32
+
+# fasthash64's mix constant (m) and seed, see store/ebpf/utils.h:120-168.
+_M = 0x880355F21E6D1965
+_SEED = 0xDEADBEEF
+
+
+def _mix(hi, lo):
+    """fasthash64 mix step: h ^= h >> 23; h *= 0x2127599bf4325c37; h ^= h >> 47."""
+    s_hi, s_lo = u64.shr(hi, lo, 23)
+    hi, lo = u64.xor(hi, lo, s_hi, s_lo)
+    c_hi, c_lo = u64.const(0x2127599BF4325C37)
+    hi, lo = u64.mul(hi, lo, c_hi, c_lo)
+    s_hi, s_lo = u64.shr(hi, lo, 47)
+    return u64.xor(hi, lo, s_hi, s_lo)
+
+
+def hash64(key_hi, key_lo):
+    """fasthash64 of a single u64 key (len=8, fixed seed), on uint32 pairs."""
+    m_hi, m_lo = u64.const(_M)
+    # h = seed ^ (8 * m)
+    h0 = (_SEED ^ (8 * _M)) & ((1 << 64) - 1)
+    h_hi, h_lo = u64.const(h0)
+    h_hi = jnp.broadcast_to(h_hi, key_hi.shape).astype(U32)
+    h_lo = jnp.broadcast_to(h_lo, key_lo.shape).astype(U32)
+    v_hi, v_lo = _mix(key_hi.astype(U32), key_lo.astype(U32))
+    h_hi, h_lo = u64.xor(h_hi, h_lo, v_hi, v_lo)
+    h_hi, h_lo = u64.mul(h_hi, h_lo, m_hi, m_lo)
+    return _mix(h_hi, h_lo)
+
+
+def hash64_np(key: np.ndarray) -> np.ndarray:
+    """Host-side fasthash64, bit-identical to hash64 (validated in tests)."""
+    mask = np.uint64(0xFFFFFFFFFFFFFFFF)
+    m = np.uint64(_M)
+    c = np.uint64(0x2127599BF4325C37)
+
+    def mix(h):
+        h = h ^ (h >> np.uint64(23))
+        with np.errstate(over="ignore"):
+            h = (h * c) & mask
+        return h ^ (h >> np.uint64(47))
+
+    key = np.asarray(key, np.uint64)
+    h = np.uint64((_SEED ^ (8 * _M)) & 0xFFFFFFFFFFFFFFFF)
+    with np.errstate(over="ignore"):
+        h = (h ^ mix(key)) * m & mask
+    return mix(h)
+
+
+def bucket(key_hi, key_lo, n_buckets: int):
+    """key -> bucket index in [0, n_buckets); n_buckets must be a power of 2."""
+    assert n_buckets & (n_buckets - 1) == 0, "n_buckets must be a power of two"
+    _, lo = hash64(key_hi, key_lo)
+    return (lo & U32(n_buckets - 1)).astype(jnp.int32)
+
+
+def bucket_np(key, n_buckets: int):
+    assert n_buckets & (n_buckets - 1) == 0
+    return (hash64_np(key) & np.uint64(n_buckets - 1)).astype(np.int64)
+
+
+def bloom_bit(key_hi, key_lo):
+    """key -> bit position in a 64-bit per-bucket bloom filter.
+
+    Mirrors the reference's use of the hash's top 6 bits
+    (store/ebpf/store_kern.c:88-95).
+    """
+    hi, _ = hash64(key_hi, key_lo)
+    return (hi >> U32(26)).astype(jnp.int32)  # top 6 bits of the 64-bit hash
+
+
+def bloom_bit_np(key):
+    return (hash64_np(key) >> np.uint64(58)).astype(np.int64)

@@ -1,0 +1,129 @@
+"""Sort + segmented-reduction primitives: the batch conflict-resolution core.
+
+The reference serializes conflicting ops with per-entry CAS spinlocks and
+RETRY-to-client (store/ebpf/store_kern.c:62-67, lock_2pl/caladan/server.cc:51-57).
+On TPU there is no spinning: a step takes a batch of R requests, sorts them by
+64-bit key (stable in arrival order), groups equal keys into segments, and
+resolves each segment with *closed-form* segmented reductions that are
+serial-equivalent to processing the segment's requests one at a time in
+arrival order. Table updates then have exactly one writer per key (the
+segment representative), so scatters are conflict-free and deterministic.
+
+Everything here is shape-static and jit/vmap/shard_map friendly.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+I32 = jnp.int32
+
+
+class SortedBatch(NamedTuple):
+    """A batch sorted by (key_hi, key_lo, arrival order).
+
+    All fields have shape [R]. ``perm`` maps sorted position -> original
+    position; replies computed in sorted order are returned to original order
+    with :func:`unsort`.
+    """
+    key_hi: jax.Array
+    key_lo: jax.Array
+    perm: jax.Array       # int32: original index of each sorted element
+    head: jax.Array       # bool: first element of its key segment
+    last: jax.Array       # bool: last element of its key segment
+    head_pos: jax.Array   # int32: sorted position of this segment's head
+    seg_id: jax.Array     # int32: dense segment id (0..n_segments-1)
+    rank: jax.Array       # int32: position within segment (0 = earliest arrival)
+
+
+def sort_batch(key_hi, key_lo) -> SortedBatch:
+    """Sort a batch of 64-bit keys; arrival order (= index) breaks ties."""
+    r = key_hi.shape[0]
+    order = jnp.arange(r, dtype=I32)
+    s_hi, s_lo, perm = jax.lax.sort((key_hi, key_lo, order), num_keys=3)
+    head = jnp.concatenate(
+        [jnp.ones((1,), bool),
+         (s_hi[1:] != s_hi[:-1]) | (s_lo[1:] != s_lo[:-1])])
+    last = jnp.concatenate([head[1:], jnp.ones((1,), bool)])
+    idx = jnp.arange(r, dtype=I32)
+    head_pos = jax.lax.cummax(jnp.where(head, idx, 0))
+    seg_id = jnp.cumsum(head.astype(I32)) - 1
+    rank = idx - head_pos
+    return SortedBatch(s_hi, s_lo, perm, head, last, head_pos, seg_id, rank)
+
+
+def at_head(sb: SortedBatch, x):
+    """Broadcast each segment's head value of x to every element."""
+    return x[sb.head_pos]
+
+
+def seg_sum(sb: SortedBatch, x):
+    """Per-element inclusive-total: sum of x over the element's whole segment."""
+    r = sb.key_hi.shape[0]
+    totals = jax.ops.segment_sum(x, sb.seg_id, num_segments=r)
+    return totals[sb.seg_id]
+
+
+def seg_cumsum_excl(sb: SortedBatch, x):
+    """Segmented exclusive prefix sum (sum of x over earlier-arrival same-key)."""
+    cs = jnp.cumsum(x, axis=0)
+    incl = cs - (cs[sb.head_pos] - x[sb.head_pos])
+    return incl - x
+
+
+def seg_min_where(sb: SortedBatch, pred, x, default):
+    """Per-segment min of x over elements where pred, broadcast to all.
+
+    ``default`` is returned exactly for segments with no element satisfying
+    pred (masked-out elements contribute the reduction identity, not default).
+    """
+    r = sb.key_hi.shape[0]
+    ident = jnp.iinfo(x.dtype).max
+    masked = jnp.where(pred, x, ident)
+    mins = jax.ops.segment_min(masked, sb.seg_id, num_segments=r)[sb.seg_id]
+    return jnp.where(seg_any(sb, pred), mins, default)
+
+
+def seg_max_where(sb: SortedBatch, pred, x, default):
+    """Per-segment max of x over elements where pred, broadcast to all.
+
+    ``default`` is returned exactly for segments with no element satisfying pred.
+    """
+    r = sb.key_hi.shape[0]
+    ident = jnp.iinfo(x.dtype).min
+    masked = jnp.where(pred, x, ident)
+    maxs = jax.ops.segment_max(masked, sb.seg_id, num_segments=r)[sb.seg_id]
+    return jnp.where(seg_any(sb, pred), maxs, default)
+
+
+def seg_any(sb: SortedBatch, pred):
+    return seg_sum(sb, pred.astype(I32)) > 0
+
+
+def first_rank_where(sb: SortedBatch, pred):
+    """Rank (within segment) of the earliest element satisfying pred, or big."""
+    big = jnp.int32(1 << 30)
+    return seg_min_where(sb, pred, sb.rank, big)
+
+
+def unsort(sb: SortedBatch, *xs):
+    """Return arrays computed in sorted order to original batch order."""
+    out = []
+    for x in xs:
+        o = jnp.zeros_like(x)
+        out.append(o.at[sb.perm].set(x))
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+def scatter_rows(table, row_idx, values, mask):
+    """table[row_idx[i]] = values[i] where mask[i]; masked lanes are dropped.
+
+    One-writer discipline is the caller's job (pass mask = segment-last).
+    Masked lanes are routed out of range and dropped, so no sentinel row is
+    needed in the table.
+    """
+    n = table.shape[0]
+    safe_idx = jnp.where(mask, row_idx, n)  # out of range -> dropped
+    return table.at[safe_idx].set(values, mode="drop")

@@ -1,0 +1,103 @@
+"""64-bit key handling on TPU without x64 mode.
+
+TPUs natively operate on 32-bit lanes; JAX's default configuration downcasts
+uint64 to uint32. Rather than enable global x64 (which would also pull f64
+emulation into every kernel), device code represents a 64-bit key as a pair of
+uint32 arrays ``(hi, lo)``. Host code (numpy) uses plain uint64.
+
+The reference's keys are u64 (e.g. `struct message.key`,
+/root/reference/tatp/ebpf/utils.h:80-87); TATP composite keys pack
+(s_id, sf_type, start_time) into one u64, so full 64-bit fidelity is kept.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+U32 = jnp.uint32
+
+
+def split(x: np.ndarray):
+    """Host-side: uint64 ndarray -> (hi, lo) uint32 ndarrays."""
+    x = np.asarray(x, dtype=np.uint64)
+    return (x >> np.uint64(32)).astype(np.uint32), (x & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+
+
+def join(hi, lo) -> np.ndarray:
+    """Host-side: (hi, lo) uint32 ndarrays -> uint64 ndarray."""
+    return (np.asarray(hi, np.uint64) << np.uint64(32)) | np.asarray(lo, np.uint64)
+
+
+def eq(a_hi, a_lo, b_hi, b_lo):
+    """Elementwise 64-bit equality on (hi, lo) pairs."""
+    return (a_hi == b_hi) & (a_lo == b_lo)
+
+
+def const(value: int):
+    """Python int -> (hi, lo) uint32 scalars (jnp)."""
+    value &= (1 << 64) - 1
+    return U32(value >> 32), U32(value & 0xFFFFFFFF)
+
+
+def add32c(a, b):
+    """uint32 add with carry-out: returns (sum, carry)."""
+    s = a + b
+    return s, (s < a).astype(U32)
+
+
+def add(a_hi, a_lo, b_hi, b_lo):
+    """64-bit add on pairs."""
+    lo, c = add32c(a_lo, b_lo)
+    return a_hi + b_hi + c, lo
+
+
+def xor(a_hi, a_lo, b_hi, b_lo):
+    return a_hi ^ b_hi, a_lo ^ b_lo
+
+
+def shr(hi, lo, n: int):
+    """Logical shift right by constant n (0 < n < 64)."""
+    if n >= 32:
+        return jnp.zeros_like(hi), hi >> U32(n - 32) if n > 32 else hi
+    return hi >> U32(n), (lo >> U32(n)) | (hi << U32(32 - n))
+
+
+def shl(hi, lo, n: int):
+    if n >= 32:
+        return (lo << U32(n - 32)) if n > 32 else lo, jnp.zeros_like(lo)
+    return (hi << U32(n)) | (lo >> U32(32 - n)), lo << U32(n)
+
+
+def mul32x32(a, b):
+    """Full 32x32 -> 64-bit product as (hi, lo) using 16-bit limbs.
+
+    Avoids uint64 entirely so it lowers to plain 32-bit VPU multiplies.
+    """
+    a = a.astype(U32)
+    b = b.astype(U32)
+    a_lo = a & U32(0xFFFF)
+    a_hi = a >> U32(16)
+    b_lo = b & U32(0xFFFF)
+    b_hi = b >> U32(16)
+    ll = a_lo * b_lo                      # <= 2^32 - 2^17 + 1, fits
+    lh = a_lo * b_hi
+    hl = a_hi * b_lo
+    hh = a_hi * b_hi
+    # mid = lh + hl + (ll >> 16), may carry past 32 bits
+    mid, c1 = add32c(lh, hl)
+    mid, c2 = add32c(mid, ll >> U32(16))
+    lo = (mid << U32(16)) | (ll & U32(0xFFFF))
+    hi = hh + (mid >> U32(16)) + ((c1 + c2) << U32(16))
+    return hi, lo
+
+
+def mul(a_hi, a_lo, b_hi, b_lo):
+    """64x64 -> low 64 bits of product, as pairs."""
+    hi, lo = mul32x32(a_lo, b_lo)
+    hi = hi + a_lo * b_hi + a_hi * b_lo
+    return hi, lo
+
+
+def lt(a_hi, a_lo, b_hi, b_lo):
+    """Unsigned 64-bit less-than."""
+    return (a_hi < b_hi) | ((a_hi == b_hi) & (a_lo < b_lo))

@@ -1,0 +1,1 @@
+from . import kv, locks, log  # noqa: F401

@@ -1,0 +1,195 @@
+"""HBM-resident bucketed hash table.
+
+TPU re-expression of the reference's two storage layers collapsed into one:
+the in-kernel cache (`struct cache_entry` {key[4], val[4][V], ver[4],
+valid[4], dirty[4], bloom, lock}, /root/reference/store/ebpf/utils.h:58-66)
+and the userspace chained KVS (store/ebpf/kvs.h:10-153). Here the table is
+sized to hold the whole keyspace in HBM, so the fast path always "hits"
+(capacity permitting); bucket overflow surfaces as a SPILL reply for a host
+overflow store instead of an eviction protocol.
+
+Layout (struct-of-arrays, S slots per bucket):
+  key_hi/key_lo  u32 [NB, S]   64-bit keys as uint32 pairs
+  val            u32 [NB, S, VW]
+  ver            u32 [NB, S]
+  valid          bool [NB, S]
+  bloom_hi/lo    u32 [NB]      64-bit per-bucket bloom (negative lookups)
+
+The per-entry CAS `lock` word of the reference has no equivalent: intra-batch
+conflicts are resolved deterministically (ops.segments), so the table needs no
+locks at all.
+"""
+from __future__ import annotations
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import hashing, segments, u64
+from ..ops.u64 import U32
+
+I32 = jnp.int32
+
+
+@flax.struct.dataclass
+class KVTable:
+    key_hi: jax.Array
+    key_lo: jax.Array
+    val: jax.Array
+    ver: jax.Array
+    valid: jax.Array
+    bloom_hi: jax.Array
+    bloom_lo: jax.Array
+
+    @property
+    def n_buckets(self):
+        return self.key_hi.shape[0]
+
+    @property
+    def slots(self):
+        return self.key_hi.shape[1]
+
+    @property
+    def val_words(self):
+        return self.val.shape[2]
+
+
+def create(n_buckets: int, slots: int = 4, val_words: int = 10) -> KVTable:
+    assert n_buckets & (n_buckets - 1) == 0
+    return KVTable(
+        key_hi=jnp.zeros((n_buckets, slots), U32),
+        key_lo=jnp.zeros((n_buckets, slots), U32),
+        val=jnp.zeros((n_buckets, slots, val_words), U32),
+        ver=jnp.zeros((n_buckets, slots), U32),
+        valid=jnp.zeros((n_buckets, slots), bool),
+        bloom_hi=jnp.zeros((n_buckets,), U32),
+        bloom_lo=jnp.zeros((n_buckets,), U32),
+    )
+
+
+def probe(table: KVTable, key_hi, key_lo, bkt):
+    """Find each key's slot in its bucket.
+
+    Returns (hit [R] bool, slot [R] i32, val [R, VW], ver [R]) against the
+    table's current state. ``slot`` is arbitrary when not hit.
+    """
+    rows_hi = table.key_hi[bkt]          # [R, S]
+    rows_lo = table.key_lo[bkt]
+    rows_valid = table.valid[bkt]
+    match = rows_valid & (rows_hi == key_hi[:, None]) & (rows_lo == key_lo[:, None])
+    hit = match.any(axis=-1)
+    slot = jnp.argmax(match, axis=-1).astype(I32)
+    val = table.val[bkt, slot]
+    ver = table.ver[bkt, slot]
+    return hit, slot, val, ver
+
+
+def bloom_maybe(table: KVTable, key_hi, key_lo, bkt):
+    """True if the bucket's bloom filter admits the key (possibly present)."""
+    bit = hashing.bloom_bit(key_hi, key_lo)           # [R] in [0, 64)
+    use_hi = bit >= 32
+    word = jnp.where(use_hi, table.bloom_hi[bkt], table.bloom_lo[bkt])
+    shift = jnp.where(use_hi, bit - 32, bit).astype(U32)
+    return ((word >> shift) & U32(1)) == U32(1)
+
+
+def nth_free_slot(valid_rows, rank):
+    """For each request: index of the (rank+1)-th free slot in its bucket row.
+
+    valid_rows: bool [R, S]; rank: i32 [R].
+    Returns (has_free [R] bool, slot [R] i32).
+    """
+    free = ~valid_rows
+    cumfree = jnp.cumsum(free.astype(I32), axis=-1)
+    want = free & (cumfree == (rank[:, None] + 1))
+    has = want.any(axis=-1)
+    slot = jnp.argmax(want, axis=-1).astype(I32)
+    return has, slot
+
+
+def recompute_bloom(table: KVTable, bkt, write_mask):
+    """Recompute the 64-bit bloom word for each (masked) bucket from its live
+    keys, and scatter back. Exact — unlike the reference, which can only OR
+    bits in-kernel and recomputes in userspace on DELETE
+    (tatp/ebpf/shard_user.c DELETE path)."""
+    rows_hi = table.key_hi[bkt]          # [R, S]
+    rows_lo = table.key_lo[bkt]
+    rows_valid = table.valid[bkt]
+    bit = hashing.bloom_bit(rows_hi, rows_lo)         # [R, S]
+    hi_bits = jnp.where(rows_valid & (bit >= 32),
+                        U32(1) << jnp.clip(bit - 32, 0, 31).astype(U32), U32(0))
+    lo_bits = jnp.where(rows_valid & (bit < 32),
+                        U32(1) << jnp.clip(bit, 0, 31).astype(U32), U32(0))
+    new_hi = hi_bits[:, 0]
+    new_lo = lo_bits[:, 0]
+    for s in range(1, hi_bits.shape[1]):  # static, small S
+        new_hi = new_hi | hi_bits[:, s]
+        new_lo = new_lo | lo_bits[:, s]
+    return table.replace(
+        bloom_hi=segments.scatter_rows(table.bloom_hi, bkt, new_hi, write_mask),
+        bloom_lo=segments.scatter_rows(table.bloom_lo, bkt, new_lo, write_mask),
+    )
+
+
+# ---------------------------------------------------------------- host-side
+
+
+def to_dict(table: KVTable) -> dict:
+    """Dump live entries to {key: (val tuple, ver)} for differential tests."""
+    valid = np.asarray(table.valid)
+    b, s = np.nonzero(valid)
+    keys = u64.join(np.asarray(table.key_hi)[b, s], np.asarray(table.key_lo)[b, s])
+    vals = np.asarray(table.val)[b, s]
+    vers = np.asarray(table.ver)[b, s]
+    return {int(k): (tuple(int(x) for x in v), int(ver))
+            for k, v, ver in zip(keys, vals, vers)}
+
+
+def populate(table: KVTable, keys: np.ndarray, vals: np.ndarray,
+             vers: np.ndarray | None = None) -> KVTable:
+    """Bulk-load a table host-side (numpy), like the reference's populate
+    phase (smallbank/ebpf/shard_user.c:74-77, tatp/caladan/server_shard.cc:56-70).
+
+    Raises if a bucket overflows — table sizing must cover the keyspace,
+    mirroring e.g. SAV_HASH_SIZE = ACCOUNT_NUM*3/2/4 (smallbank/ebpf/utils.h:16-17).
+    """
+    nb, s = table.key_hi.shape
+    keys = np.asarray(keys, np.uint64)
+    if len(np.unique(keys)) != len(keys):
+        raise ValueError("duplicate keys in populate")
+    vals = np.asarray(vals, np.uint32)
+    if vers is None:
+        vers = np.ones(len(keys), np.uint32)
+    bkt = hashing.bucket_np(keys, nb)
+    order = np.argsort(bkt, kind="stable")
+    slot = np.zeros(len(keys), np.int64)
+    counts = np.zeros(nb, np.int64)
+    np.add.at(counts, bkt, 1)
+    if counts.max() > s:
+        raise ValueError(f"bucket overflow during populate: max {counts.max()} > {s} slots")
+    # slot = running index within bucket
+    sorted_bkt = bkt[order]
+    start = np.concatenate([[True], sorted_bkt[1:] != sorted_bkt[:-1]])
+    within = np.arange(len(keys)) - np.maximum.accumulate(np.where(start, np.arange(len(keys)), 0))
+    slot[order] = within
+
+    k_hi, k_lo = u64.split(keys)
+    key_hi = np.zeros((nb, s), np.uint32)
+    key_lo = np.zeros((nb, s), np.uint32)
+    val = np.zeros((nb, s, table.val_words), np.uint32)
+    ver = np.zeros((nb, s), np.uint32)
+    valid = np.zeros((nb, s), bool)
+    key_hi[bkt, slot] = k_hi
+    key_lo[bkt, slot] = k_lo
+    val[bkt, slot] = vals
+    ver[bkt, slot] = vers
+    valid[bkt, slot] = True
+    bits = hashing.bloom_bit_np(keys)
+    bloom = np.zeros(nb, np.uint64)
+    np.bitwise_or.at(bloom, bkt, np.uint64(1) << bits.astype(np.uint64))
+    b_hi, b_lo = u64.split(bloom)
+    return KVTable(key_hi=jnp.asarray(key_hi), key_lo=jnp.asarray(key_lo),
+                   val=jnp.asarray(val), ver=jnp.asarray(ver),
+                   valid=jnp.asarray(valid),
+                   bloom_hi=jnp.asarray(b_hi), bloom_lo=jnp.asarray(b_lo))

@@ -1,0 +1,63 @@
+"""Lock and version tables.
+
+TPU re-expression of the reference's lock arrays:
+  - 2PL no-wait S/X counters `struct lock_unit {lock, num_sh, num_ex}`
+    (lock_2pl/ebpf/utils.h; smallbank/ebpf/shard_kern.c:26-38)
+  - FaSST OCC single lock word + version table
+    (lock_fasst/ebpf/ls_kern.c; tatp/ebpf/shard_kern.c:26-59)
+
+Keys map to lock slots via hash, exactly like the reference
+(fasthash64(key) % kLockHashSize, lock_2pl/caladan/proto.h:8) — hash
+collisions conflate locks, which is accepted behavior there and here.
+The reference's per-unit CAS spinlock (`lock` field) has no TPU equivalent:
+batch certification makes each step's grants deterministic.
+"""
+from __future__ import annotations
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from ..ops import hashing
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+
+@flax.struct.dataclass
+class SXLockTable:
+    """No-wait 2PL shared/exclusive counters, one unit per hash slot."""
+    num_sh: jax.Array   # i32 [NL]
+    num_ex: jax.Array   # i32 [NL]
+
+    @property
+    def n_slots(self):
+        return self.num_sh.shape[0]
+
+
+def create_sx(n_slots: int) -> SXLockTable:
+    assert n_slots & (n_slots - 1) == 0
+    return SXLockTable(num_sh=jnp.zeros((n_slots,), I32),
+                       num_ex=jnp.zeros((n_slots,), I32))
+
+
+@flax.struct.dataclass
+class OCCTable:
+    """FaSST-style OCC state: lock bit + record version per hash slot."""
+    locked: jax.Array   # bool [NL]
+    ver: jax.Array      # u32 [NL]
+
+    @property
+    def n_slots(self):
+        return self.locked.shape[0]
+
+
+def create_occ(n_slots: int) -> OCCTable:
+    assert n_slots & (n_slots - 1) == 0
+    return OCCTable(locked=jnp.zeros((n_slots,), bool),
+                    ver=jnp.zeros((n_slots,), U32))
+
+
+def lock_slot(key_hi, key_lo, n_slots: int):
+    """key -> lock-table slot (hash-sharded, collisions conflate)."""
+    return hashing.bucket(key_hi, key_lo, n_slots)

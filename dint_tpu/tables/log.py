@@ -1,0 +1,80 @@
+"""Replication log: fixed-capacity multi-lane append-only rings.
+
+TPU re-expression of the reference's per-CPU log rings
+(`BPF_MAP_TYPE_PERCPU_ARRAY` of `struct log_entry {is_del, table, key, val,
+ver}` + per-CPU counter, log_server/ebpf/ls_kern.c:26-38, append at :63-77;
+userspace equivalents smallbank/udp/server_shard.cc:175-186).
+
+Lanes replace CPUs: a batch's appends are distributed across L lanes, each
+append gets slot = head[lane] + its arrival rank within the lane, and heads
+advance by per-lane counts — all as one conflict-free scatter. Rings wrap,
+exactly like the reference (ls_kern.c:72-73).
+
+Entry layout (u32 words): [flags(is_del|table<<8), key_hi, key_lo, ver, val...]
+"""
+from __future__ import annotations
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+HDR_WORDS = 4
+
+
+@flax.struct.dataclass
+class LogRing:
+    entries: jax.Array   # u32 [L, CAP, HDR_WORDS + VW]
+    head: jax.Array      # u32 [L] (monotonic; slot = head % CAP)
+
+    @property
+    def lanes(self):
+        return self.entries.shape[0]
+
+    @property
+    def capacity(self):
+        return self.entries.shape[1]
+
+
+def create(lanes: int, capacity: int, val_words: int = 10) -> LogRing:
+    assert capacity & (capacity - 1) == 0
+    return LogRing(entries=jnp.zeros((lanes, capacity, HDR_WORDS + val_words), U32),
+                   head=jnp.zeros((lanes,), U32))
+
+
+def append(ring: LogRing, do_append, table_id, is_del, key_hi, key_lo, ver, val):
+    """Batched append. do_append: bool [R]; others [R]/[R, VW].
+
+    Lane assignment is round-robin by lane index over the batch (the
+    reference's per-CPU choice is likewise load-balancing, not semantic).
+    Returns (ring', lane [R], slot [R]).
+    """
+    r = do_append.shape[0]
+    lanes = ring.lanes
+    cap = ring.capacity
+    idx = jnp.arange(r, dtype=I32)
+    lane = idx % lanes
+    # rank of this request among appends in its lane (arrival order)
+    one = do_append.astype(I32)
+    # per-lane exclusive running count: segment by lane via scatter-free trick —
+    # lane pattern is round-robin so lane l's appends are at positions l, l+L, ...
+    # rank = (# of appends at positions j < i with j % L == l). Compute with a
+    # cumulative sum per residue class using reshape (r must be multiple of L).
+    pad = (-r) % lanes
+    one_p = jnp.pad(one, (0, pad)).reshape(-1, lanes)            # [rows, L]
+    excl = jnp.cumsum(one_p, axis=0) - one_p                     # [rows, L]
+    rank = excl.reshape(-1)[:r]
+    lane_counts = one_p.sum(axis=0).astype(U32)                  # [L]
+    pos = ring.head[lane] + rank.astype(U32)
+    slot = (pos % U32(cap)).astype(I32)
+
+    flags = (is_del.astype(U32) | (table_id.astype(U32) << U32(8)))
+    entry = jnp.concatenate(
+        [flags[:, None], key_hi[:, None], key_lo[:, None], ver[:, None],
+         val.astype(U32)], axis=1)
+    safe_lane = jnp.where(do_append, lane, lanes)
+    new_entries = ring.entries.at[safe_lane, slot].set(entry, mode="drop")
+    new_head = ring.head + lane_counts
+    return ring.replace(entries=new_entries, head=new_head), lane, slot

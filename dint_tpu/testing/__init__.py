@@ -1,0 +1,1 @@
+from . import oracle  # noqa: F401

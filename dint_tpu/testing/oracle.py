@@ -1,0 +1,143 @@
+"""Sequential (pure Python dict) oracles for differential testing.
+
+Each oracle implements the *same serialization contract* that the batched
+engine documents — so engine output must match the oracle exactly, batch for
+batch. This supplies what the reference lacks entirely (it has no unit tests;
+correctness there rests on magic-byte asserts and cross-backend equivalence,
+see SURVEY.md §4); the oracle here plays the role of the reference's
+"other backend" in cross-backend differential testing.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..engines.types import Op, Reply
+
+VER0 = 0
+
+
+class StoreOracle:
+    """Sequential model of engines.store: per key, GETs see pre-batch state,
+    then writes apply in lane order; SET/INSERT are upserts bumping a
+    monotonic version; DELETE invalidates."""
+
+    def __init__(self):
+        self.data: dict[int, tuple[tuple, int]] = {}   # key -> (val tuple, ver)
+
+    def step(self, ops, keys, vals):
+        r = len(ops)
+        rtype = np.zeros(r, np.int32)
+        rver = np.zeros(r, np.uint32)
+        rval = np.zeros((r, np.asarray(vals).shape[1]), np.uint32)
+        # phase 1: reads against pre-state
+        for i in range(r):
+            if ops[i] == Op.GET:
+                ent = self.data.get(int(keys[i]))
+                if ent is None:
+                    rtype[i] = Reply.NOT_EXIST
+                else:
+                    rtype[i] = Reply.VAL
+                    rval[i] = ent[0]
+                    rver[i] = ent[1]
+        # phase 2: writes in lane order
+        # version base = pre-batch version, recorded at the key's first write
+        # in the batch; versions stay monotonic across delete+reinsert within
+        # a batch (ABA avoidance — stronger than the reference's kvs)
+        base: dict[int, int] = {}
+        cnt: dict[int, int] = {}
+
+        def touch(k):
+            if k not in base:
+                base[k] = self.data[k][1] if k in self.data else VER0
+                cnt[k] = 0
+
+        for i in range(r):
+            k = int(keys[i])
+            if ops[i] in (Op.SET, Op.INSERT):
+                touch(k)
+                cnt[k] += 1
+                ver = base[k] + cnt[k]
+                self.data[k] = (tuple(int(x) for x in vals[i]), ver)
+                rtype[i] = Reply.ACK
+                rver[i] = ver
+            elif ops[i] == Op.DELETE:
+                touch(k)
+                if k in self.data:
+                    del self.data[k]
+                    rtype[i] = Reply.ACK
+                else:
+                    rtype[i] = Reply.NOT_EXIST
+        return rtype, rval, rver
+
+
+class SXLockOracle:
+    """Sequential model of engines.lock2pl: per slot, releases apply first,
+    then acquires in lane order under no-wait 2PL."""
+
+    def __init__(self, n_slots: int):
+        self.num_sh = np.zeros(n_slots, np.int64)
+        self.num_ex = np.zeros(n_slots, np.int64)
+
+    def step(self, ops, slots):
+        r = len(ops)
+        rtype = np.zeros(r, np.int32)
+        for i in range(r):  # releases first
+            s = int(slots[i])
+            if ops[i] == Op.REL_S:
+                self.num_sh[s] = max(self.num_sh[s] - 1, 0)
+                rtype[i] = Reply.ACK
+            elif ops[i] == Op.REL_X:
+                self.num_ex[s] = max(self.num_ex[s] - 1, 0)
+                rtype[i] = Reply.ACK
+        for i in range(r):  # acquires in lane order
+            s = int(slots[i])
+            if ops[i] == Op.ACQ_S:
+                if self.num_ex[s] == 0:
+                    self.num_sh[s] += 1
+                    rtype[i] = Reply.GRANT
+                else:
+                    rtype[i] = Reply.REJECT
+            elif ops[i] == Op.ACQ_X:
+                if self.num_ex[s] == 0 and self.num_sh[s] == 0:
+                    self.num_ex[s] += 1
+                    rtype[i] = Reply.GRANT
+                else:
+                    rtype[i] = Reply.REJECT
+        return rtype
+
+
+class OCCOracle:
+    """Sequential model of engines.fasst: per slot, unlocks (commit/abort)
+    first, then reads, then lock acquires in lane order."""
+
+    def __init__(self, n_slots: int):
+        self.locked = np.zeros(n_slots, bool)
+        self.ver = np.zeros(n_slots, np.uint32)
+
+    def step(self, ops, slots):
+        r = len(ops)
+        rtype = np.zeros(r, np.int32)
+        rver = np.zeros(r, np.uint32)
+        for i in range(r):  # commits/aborts first
+            s = int(slots[i])
+            if ops[i] == Op.COMMIT_VER:
+                self.ver[s] += 1
+                self.locked[s] = False
+                rtype[i] = Reply.ACK
+            elif ops[i] == Op.ABORT:
+                self.locked[s] = False
+                rtype[i] = Reply.ACK
+        for i in range(r):  # reads see post-commit versions
+            if ops[i] == Op.READ_VER:
+                s = int(slots[i])
+                rtype[i] = Reply.VAL
+                rver[i] = self.ver[s]
+        for i in range(r):  # lock acquires in lane order
+            if ops[i] == Op.LOCK:
+                s = int(slots[i])
+                if not self.locked[s]:
+                    self.locked[s] = True
+                    rtype[i] = Reply.GRANT
+                else:
+                    rtype[i] = Reply.REJECT
+        return rtype, rver

@@ -1,0 +1,17 @@
+"""Test harness: force CPU backend with 8 virtual devices so the full
+multi-chip sharding matrix runs without TPU hardware (the driver separately
+dry-run-compiles the multi-chip path; real-chip perf is bench.py's job)."""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

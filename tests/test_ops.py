@@ -1,0 +1,131 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dint_tpu.ops import hashing, segments, u64
+
+
+def test_u64_split_join_roundtrip(rng):
+    x = rng.integers(0, 1 << 64, size=1000, dtype=np.uint64)
+    hi, lo = u64.split(x)
+    assert np.array_equal(u64.join(hi, lo), x)
+
+
+def test_u64_mul_matches_numpy(rng):
+    a = rng.integers(0, 1 << 64, size=512, dtype=np.uint64)
+    b = rng.integers(0, 1 << 64, size=512, dtype=np.uint64)
+    a_hi, a_lo = u64.split(a)
+    b_hi, b_lo = u64.split(b)
+    hi, lo = jax.jit(u64.mul)(jnp.asarray(a_hi), jnp.asarray(a_lo),
+                              jnp.asarray(b_hi), jnp.asarray(b_lo))
+    with np.errstate(over="ignore"):
+        want = a * b
+    assert np.array_equal(u64.join(np.asarray(hi), np.asarray(lo)), want)
+
+
+def test_u64_shr_shl(rng):
+    x = rng.integers(0, 1 << 64, size=256, dtype=np.uint64)
+    hi, lo = map(jnp.asarray, u64.split(x))
+    for n in (1, 16, 23, 31, 32, 33, 47, 63):
+        s_hi, s_lo = u64.shr(hi, lo, n)
+        assert np.array_equal(u64.join(np.asarray(s_hi), np.asarray(s_lo)),
+                              x >> np.uint64(n)), f"shr {n}"
+        s_hi, s_lo = u64.shl(hi, lo, n)
+        with np.errstate(over="ignore"):
+            want = (x << np.uint64(n)) & np.uint64(0xFFFFFFFFFFFFFFFF)
+        assert np.array_equal(u64.join(np.asarray(s_hi), np.asarray(s_lo)), want), f"shl {n}"
+
+
+def test_u64_add_lt(rng):
+    a = rng.integers(0, 1 << 64, size=256, dtype=np.uint64)
+    b = rng.integers(0, 1 << 64, size=256, dtype=np.uint64)
+    a_hi, a_lo = map(jnp.asarray, u64.split(a))
+    b_hi, b_lo = map(jnp.asarray, u64.split(b))
+    s_hi, s_lo = u64.add(a_hi, a_lo, b_hi, b_lo)
+    with np.errstate(over="ignore"):
+        want = a + b
+    assert np.array_equal(u64.join(np.asarray(s_hi), np.asarray(s_lo)), want)
+    assert np.array_equal(np.asarray(u64.lt(a_hi, a_lo, b_hi, b_lo)), a < b)
+
+
+def test_hash_device_matches_host(rng):
+    keys = rng.integers(0, 1 << 64, size=2048, dtype=np.uint64)
+    hi, lo = map(jnp.asarray, u64.split(keys))
+    d_hi, d_lo = jax.jit(hashing.hash64)(hi, lo)
+    got = u64.join(np.asarray(d_hi), np.asarray(d_lo))
+    assert np.array_equal(got, hashing.hash64_np(keys))
+
+
+def test_bucket_and_bloom(rng):
+    keys = rng.integers(0, 1 << 64, size=4096, dtype=np.uint64)
+    hi, lo = map(jnp.asarray, u64.split(keys))
+    nb = 1 << 14
+    b = np.asarray(jax.jit(lambda h, l: hashing.bucket(h, l, nb))(hi, lo))
+    assert np.array_equal(b, hashing.bucket_np(keys, nb))
+    assert b.min() >= 0 and b.max() < nb
+    # buckets should be reasonably uniform
+    counts = np.bincount(b, minlength=nb)
+    assert counts.max() <= 12
+    bb = np.asarray(jax.jit(hashing.bloom_bit)(hi, lo))
+    assert np.array_equal(bb, hashing.bloom_bit_np(keys))
+    assert bb.min() >= 0 and bb.max() < 64
+    assert len(np.unique(bb)) == 64
+
+
+def _ref_segments(keys):
+    """Sequential reference for segment structure."""
+    order = np.argsort(keys, kind="stable")
+    return order
+
+
+def test_sort_batch_structure(rng):
+    keys = rng.integers(0, 8, size=64, dtype=np.uint64)  # lots of duplicates
+    hi, lo = map(jnp.asarray, u64.split(keys))
+    sb = jax.jit(segments.sort_batch)(hi, lo)
+    perm = np.asarray(sb.perm)
+    skeys = keys[perm]
+    assert np.all(np.diff(skeys.astype(np.int64)) >= 0)
+    # stable: equal keys keep arrival order
+    for k in np.unique(skeys):
+        idxs = perm[skeys == k]
+        assert np.all(np.diff(idxs) > 0)
+    head = np.asarray(sb.head)
+    want_head = np.concatenate([[True], skeys[1:] != skeys[:-1]])
+    assert np.array_equal(head, want_head)
+    rank = np.asarray(sb.rank)
+    # rank counts arrival position within the key group
+    for k in np.unique(skeys):
+        r = rank[skeys == k]
+        assert np.array_equal(r, np.arange(len(r)))
+
+
+def test_segment_reductions(rng):
+    keys = rng.integers(0, 10, size=128, dtype=np.uint64)
+    vals = rng.integers(0, 100, size=128).astype(np.int32)
+    hi, lo = map(jnp.asarray, u64.split(keys))
+    sb = segments.sort_batch(hi, lo)
+    perm = np.asarray(sb.perm)
+    skeys, svals = keys[perm], jnp.asarray(vals[perm])
+
+    tot = np.asarray(segments.seg_sum(sb, svals))
+    excl = np.asarray(segments.seg_cumsum_excl(sb, svals))
+    for i, k in enumerate(skeys):
+        mask = skeys == k
+        assert tot[i] == vals[perm][mask].sum()
+        assert excl[i] == np.asarray(svals)[mask & (np.arange(128) < i)].sum()
+
+    # unsort roundtrip
+    out = np.asarray(segments.unsort(sb, svals))
+    assert np.array_equal(out, vals)
+
+
+def test_scatter_rows_masked():
+    table = jnp.zeros((8, 2), jnp.int32)
+    idx = jnp.array([1, 3, 3, 7], jnp.int32)
+    vals = jnp.array([[1, 1], [2, 2], [9, 9], [4, 4]], jnp.int32)
+    mask = jnp.array([True, False, True, True])
+    out = np.asarray(segments.scatter_rows(table, idx, vals, mask))
+    assert np.array_equal(out[1], [1, 1])
+    assert np.array_equal(out[3], [9, 9])  # only the masked-in writer landed
+    assert np.array_equal(out[7], [4, 4])
+    assert out.sum() == 28

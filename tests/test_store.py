@@ -1,0 +1,161 @@
+import jax
+import numpy as np
+import pytest
+
+from dint_tpu.engines import store
+from dint_tpu.engines.types import Op, Reply, make_batch
+from dint_tpu.tables import kv
+from dint_tpu.testing.oracle import StoreOracle
+
+VW = 4
+
+
+def run_step(table, ops, keys, vals, width=None, bloom=False):
+    batch = make_batch(ops, keys, vals, width=width or len(ops), val_words=VW)
+    step = jax.jit(store.step, static_argnames=("maintain_bloom",))
+    table, replies = step(table, batch, maintain_bloom=bloom)
+    return table, (np.asarray(replies.rtype), np.asarray(replies.val),
+                   np.asarray(replies.ver))
+
+
+def rand_vals(rng, n):
+    return rng.integers(0, 1 << 32, size=(n, VW), dtype=np.uint32)
+
+
+def test_get_set_basic(rng):
+    table = kv.create(1 << 10, slots=4, val_words=VW)
+    keys = np.array([7, 9, 7], dtype=np.uint64)
+    vals = rand_vals(rng, 3)
+    table, (rt, rv, rver) = run_step(table, [Op.SET, Op.SET, Op.GET], keys, vals)
+    assert rt[0] == Reply.ACK and rver[0] == 1
+    assert rt[1] == Reply.ACK and rver[1] == 1
+    # GET sees pre-batch state: key 7 absent before this batch
+    assert rt[2] == Reply.NOT_EXIST
+
+    table, (rt, rv, rver) = run_step(
+        table, [Op.GET, Op.GET, Op.GET], np.array([7, 9, 1234], np.uint64),
+        rand_vals(rng, 3))
+    assert rt[0] == Reply.VAL and np.array_equal(rv[0], vals[0]) and rver[0] == 1
+    assert rt[1] == Reply.VAL and np.array_equal(rv[1], vals[1])
+    assert rt[2] == Reply.NOT_EXIST
+
+
+def test_delete_and_bloom(rng):
+    table = kv.create(1 << 8, slots=4, val_words=VW)
+    keys = np.arange(100, dtype=np.uint64)
+    table = kv.populate(table, keys, rand_vals(rng, 100))
+    table, (rt, _, _) = run_step(table, [Op.DELETE] * 50,
+                                 np.arange(50, dtype=np.uint64), rand_vals(rng, 50),
+                                 bloom=True)
+    assert (rt == Reply.ACK).all()
+    d = kv.to_dict(table)
+    assert set(d) == set(range(50, 100))
+    # double delete -> second acks NOT_EXIST (sequential within batch)
+    table, (rt, _, _) = run_step(table, [Op.DELETE, Op.DELETE],
+                                 np.array([60, 60], np.uint64), rand_vals(rng, 2))
+    assert rt[0] == Reply.ACK and rt[1] == Reply.NOT_EXIST
+
+
+def test_conflicting_writes_same_key(rng):
+    table = kv.create(1 << 8, slots=4, val_words=VW)
+    vals = rand_vals(rng, 4)
+    # four SETs to the same key in one batch: last lane wins, ver counts all
+    table, (rt, _, rver) = run_step(table, [Op.SET] * 4,
+                                    np.full(4, 42, np.uint64), vals)
+    assert (rt == Reply.ACK).all()
+    assert list(rver) == [1, 2, 3, 4]
+    d = kv.to_dict(table)
+    assert d[42] == (tuple(int(x) for x in vals[3]), 4)
+
+
+def test_insert_after_delete_same_batch(rng):
+    table = kv.create(1 << 8, slots=4, val_words=VW)
+    v0 = rand_vals(rng, 1)
+    table = kv.populate(table, np.array([5], np.uint64), v0)
+    v = rand_vals(rng, 2)
+    table, (rt, _, _) = run_step(table, [Op.DELETE, Op.INSERT],
+                                 np.array([5, 5], np.uint64), v)
+    assert rt[0] == Reply.ACK and rt[1] == Reply.ACK
+    d = kv.to_dict(table)
+    assert d[5][0] == tuple(int(x) for x in v[1])
+
+
+def test_bucket_overflow_spills(rng):
+    # 1 bucket x 2 slots: third distinct key must SPILL
+    table = kv.create(1, slots=2, val_words=VW)
+    keys = np.array([1, 2, 3], dtype=np.uint64)
+    table, (rt, _, _) = run_step(table, [Op.INSERT] * 3, keys, rand_vals(rng, 3))
+    assert sorted(rt) == sorted([Reply.ACK, Reply.ACK, Reply.SPILL])
+    assert len(kv.to_dict(table)) == 2
+
+
+def test_spill_reply_routing(rng):
+    # full bucket: SPILL must land on the failed installs, not bystander lanes
+    table = kv.create(1, slots=2, val_words=VW)
+    table = kv.populate(table, np.array([1, 2], np.uint64), rand_vals(rng, 2))
+    # INSERT k then GET k: insert fails -> SPILL; GET sees pre-state -> NOT_EXIST
+    table, (rt, _, _) = run_step(table, [Op.INSERT, Op.GET],
+                                 np.array([9, 9], np.uint64), rand_vals(rng, 2))
+    assert list(rt) == [Reply.SPILL, Reply.NOT_EXIST]
+    # both SETs of an un-installable key must SPILL (no phantom ACK)
+    table, (rt, _, rver) = run_step(table, [Op.SET, Op.SET],
+                                    np.array([9, 9], np.uint64), rand_vals(rng, 2))
+    assert list(rt) == [Reply.SPILL, Reply.SPILL]
+    assert list(rver) == [0, 0]
+    # INSERT then DELETE of un-installable key: net effect is a no-op, so no
+    # slot is ever needed — both ops ack (serial-equivalent: the transient
+    # insert is observable by nobody)
+    table, (rt, _, _) = run_step(table, [Op.INSERT, Op.DELETE],
+                                 np.array([9, 9], np.uint64), rand_vals(rng, 2))
+    assert list(rt) == [Reply.ACK, Reply.ACK]
+    assert len(kv.to_dict(table)) == 2  # table untouched
+
+
+def test_populate_rejects_duplicates(rng):
+    table = kv.create(1 << 4, slots=4, val_words=VW)
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="duplicate"):
+        kv.populate(table, np.array([5, 5], np.uint64), rand_vals(rng, 2))
+
+
+@pytest.mark.parametrize("width", [64, 256])
+def test_differential_vs_oracle(rng, width):
+    table = kv.create(1 << 8, slots=8, val_words=VW)
+    oracle = StoreOracle()
+    keyspace = 40  # small => heavy intra-batch conflicts
+    step = jax.jit(store.step)
+    for _ in range(12):
+        n = int(rng.integers(width // 2, width + 1))
+        ops = rng.choice([Op.GET, Op.SET, Op.INSERT, Op.DELETE, Op.NOP],
+                         size=n, p=[0.4, 0.25, 0.1, 0.15, 0.1]).astype(np.int32)
+        keys = rng.integers(0, keyspace, size=n).astype(np.uint64)
+        vals = rand_vals(rng, n)
+        batch = make_batch(ops, keys, vals, width=width, val_words=VW)
+        table, replies = step(table, batch)
+        rt = np.asarray(replies.rtype)[:n]
+        rv = np.asarray(replies.val)[:n]
+        rver = np.asarray(replies.ver)[:n]
+        ot, ov, over = oracle.step(ops, keys, vals)
+        assert np.array_equal(rt, ot), (rt, ot)
+        assert np.array_equal(rver, over)
+        getmask = (ops == Op.GET) & (ot == Reply.VAL)
+        assert np.array_equal(rv[getmask], ov[getmask])
+        # full state equivalence every step
+        d = kv.to_dict(table)
+        assert d == oracle.data
+
+
+def test_bloom_exact_after_churn(rng):
+    table = kv.create(1 << 6, slots=8, val_words=VW)
+    keys = np.arange(200, dtype=np.uint64)
+    table = kv.populate(table, keys, rand_vals(rng, 200))
+    table, _ = run_step(table, [Op.DELETE] * 100, keys[:100], rand_vals(rng, 100),
+                        bloom=True)
+    # bloom must still admit all live keys (no false negatives)
+    from dint_tpu.ops import u64
+    hi, lo = map(np.asarray, u64.split(keys[100:]))
+    import jax.numpy as jnp
+    from dint_tpu.ops import hashing
+    bkt = hashing.bucket(jnp.asarray(hi), jnp.asarray(lo), table.n_buckets)
+    ok = np.asarray(kv.bloom_maybe(table, jnp.asarray(hi), jnp.asarray(lo), bkt))
+    assert ok.all()
