@@ -1,0 +1,58 @@
+"""Headline benchmark: TATP committed txns/s on one TPU chip.
+
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Protocol mirrors the reference's measurement contract (BASELINE.md): TATP
+mix 35/35/10/2/14/2/2, NURand subscriber ids, warmup then timed window,
+committed (goodput) txns/s. Baseline constant: the reference repo publishes
+no numbers (BASELINE.md "Published numbers: None"); we use 3.0e6 txn/s as a
+stand-in for tatp/ebpf on one r650 (paper-scale estimate) until measured
+side by side.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+ASSUMED_BASELINE = 3.0e6  # committed txn/s, tatp/ebpf single-server estimate
+
+
+def main():
+    from dint_tpu.clients import tatp_client as tc
+
+    rng = np.random.default_rng(0)
+    n_subscribers = 100_000
+    cohort = 4096
+    shards, _ = tc.populate_shards(rng, n_subscribers, val_words=10,
+                                   cf_buckets=1 << 19, cf_lock_slots=1 << 19)
+    coord = tc.Coordinator(shards, n_subscribers, width=8192, val_words=10)
+
+    # warmup (compile all wave shapes)
+    for _ in range(3):
+        coord.run_cohort(rng, cohort)
+
+    base_committed = coord.stats.committed
+    t0 = time.time()
+    window = 10.0
+    while time.time() - t0 < window:
+        coord.run_cohort(rng, cohort)
+    dt = time.time() - t0
+    committed = coord.stats.committed - base_committed
+    tps = committed / dt
+
+    print(json.dumps({
+        "metric": "tatp_committed_txns_per_sec",
+        "value": round(tps, 1),
+        "unit": "txn/s",
+        "vs_baseline": round(tps / ASSUMED_BASELINE, 4),
+    }))
+    print(f"abort_rate={coord.stats.abort_rate:.4f} attempted={coord.stats.attempted}",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

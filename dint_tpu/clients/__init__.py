@@ -1,0 +1,1 @@
+from . import workloads  # noqa: F401

@@ -1,0 +1,301 @@
+"""TATP transaction coordinator: batched OCC 2PC over 3 replicated shards.
+
+Host-side, vectorized equivalent of the reference's TATP client
+(tatp/caladan/client_ebpf_shard.cc): a cohort of W in-flight txns advances in
+waves through the FaSST-style OCC pipeline —
+
+  wave 1: READ read-set + LOCK write-set (fused, one step)   (:608-677)
+  wave 2: validate = re-READ, compare versions               (:688-768)
+  wave 3: CommitLog -> all 3 shards                          (:779-810)
+  wave 4: Commit/Insert/DeleteBck -> 2 backup shards         (:812-860)
+  wave 5: Commit/Insert/DeletePrim -> primary (installs + releases lock) (:862-900)
+  abort:  ABORT (unlock) each granted lock                   (:681-703)
+
+Txn mix 35/35/10/2/14/2/2 with NURand subscriber ids
+(tatp/caladan/tatp.h:40-43,57-63). Routing: shard = key % 3 per key
+(tatp/caladan/client_ebpf_shard.cc:636-641).
+
+Value layout: word0 = payload, word1 = magic (parity with
+tatp_sub_msc_location_magic etc., tatp/caladan/tatp.h:67-72).
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+
+from ..engines import tatp
+from ..engines.types import Op, Reply, make_batch
+from ..tables import kv
+from . import workloads as wl
+
+N_SHARDS = 3
+MAGIC = 0x7A79
+
+
+@dataclasses.dataclass
+class Stats:
+    attempted: int = 0
+    committed: int = 0
+    aborted_lock: int = 0      # write-set lock rejected
+    aborted_validate: int = 0  # read-set version changed
+    aborted_missing: int = 0   # required row absent / insert-exists
+
+    @property
+    def abort_rate(self):
+        return 1.0 - self.committed / max(self.attempted, 1)
+
+
+def populate_shards(rng: np.random.Generator, n_subscribers: int,
+                    val_words: int = 10, **kw):
+    """Build 3 identical replicas (reference populate:
+    tatp/caladan/client_ebpf_shard.cc:96-341). Returns (shards, cf_keys)."""
+    p1 = n_subscribers + 1
+    s_ids = np.arange(1, p1)
+
+    def mkvals(n, payload):
+        v = np.zeros((n, val_words), np.uint32)
+        v[:, 0] = payload
+        v[:, 1] = MAGIC
+        return v
+
+    # ai/sf: each subscriber has a random subset of types 1..4 (>=1)
+    ai_present = rng.random((p1, 4)) < 0.625   # avg 2.5 of 4
+    sf_present = rng.random((p1, 4)) < 0.625
+    ai_present[0] = sf_present[0] = False
+    ai_present[1:][ai_present[1:].sum(1) == 0, 0] = True
+    sf_present[1:][sf_present[1:].sum(1) == 0, 0] = True
+
+    # cf: 25% of present sf rows have each start_time
+    cf_keys = []
+    sfi, sft = np.nonzero(sf_present)
+    for st in (0, 8, 16):
+        mask = rng.random(len(sfi)) < 0.25
+        cf_keys.append(tatp.cf_key(sfi[mask], sft[mask] + 1, st))
+    cf_keys = np.unique(np.concatenate(cf_keys)).astype(np.uint64)
+
+    shard0 = tatp.create(n_subscribers, val_words=val_words, **kw)
+    del s_ids
+    sub_vals = mkvals(p1, np.arange(p1))
+    ver1 = np.ones(p1, np.uint32)
+    ver1[0] = 0
+    ai_vals = mkvals(4 * p1, np.arange(4 * p1))
+    sf_vals = mkvals(4 * p1, np.arange(4 * p1))
+    ai_ver = np.where(ai_present.reshape(-1), 1, 0).astype(np.uint32)
+    sf_ver = np.where(sf_present.reshape(-1), 1, 0).astype(np.uint32)
+
+    cf_table = kv.populate(shard0.cf, cf_keys,
+                           mkvals(len(cf_keys), cf_keys.astype(np.uint32)))
+    shards = []
+    for _ in range(N_SHARDS):
+        s = tatp.create(n_subscribers, val_words=val_words, **kw)
+        s = s.replace(
+            sub=s.sub.replace(val=jax.numpy.asarray(sub_vals),
+                              ver=jax.numpy.asarray(ver1)),
+            sec=s.sec.replace(val=jax.numpy.asarray(sub_vals),
+                              ver=jax.numpy.asarray(ver1)),
+            ai=s.ai.replace(val=jax.numpy.asarray(ai_vals),
+                            ver=jax.numpy.asarray(ai_ver)),
+            sf=s.sf.replace(val=jax.numpy.asarray(sf_vals),
+                            ver=jax.numpy.asarray(sf_ver)),
+            cf=cf_table,
+        )
+        # independent buffers per replica: steps donate their state, so
+        # replicas must not share device arrays
+        s = jax.tree.map(jax.numpy.array, s)
+        shards.append(s)
+    return shards, cf_keys
+
+
+class Coordinator:
+    def __init__(self, shards, n_subscribers: int, width: int = 4096,
+                 val_words: int = 10):
+        self.shards = list(shards)
+        self.p = n_subscribers
+        self.width = width
+        self.vw = val_words
+        # donate the shard state: steps update tables in place in HBM instead
+        # of copying the full state every call
+        self._step = jax.jit(tatp.step, donate_argnums=0)
+        self.stats = Stats()
+
+    def _run_wave(self, ops, tbls, keys, shard_of=None, vals=None, vers=None):
+        m = len(ops)
+        rt = np.zeros(m, np.int32)
+        rv = np.zeros((m, self.vw), np.uint32)
+        rver = np.zeros(m, np.uint32)
+        if vals is None:
+            vals = np.zeros((m, self.vw), np.uint32)
+        if vers is None:
+            vers = np.zeros(m, np.uint32)
+        if shard_of is None:
+            shard_of = keys % N_SHARDS
+        for s in range(N_SHARDS):
+            idx = np.nonzero(shard_of == s)[0]
+            if len(idx) == 0:
+                continue
+            assert len(idx) <= self.width
+            batch = make_batch(ops[idx], keys[idx].astype(np.uint64), vals[idx],
+                               vers=vers[idx], tables=tbls[idx],
+                               width=self.width, val_words=self.vw)
+            self.shards[s], rep = self._step(self.shards[s], batch)
+            rt[idx] = np.asarray(rep.rtype)[: len(idx)]
+            rv[idx] = np.asarray(rep.val)[: len(idx)]
+            rver[idx] = np.asarray(rep.ver)[: len(idx)]
+        return rt, rv, rver
+
+    def run_cohort(self, rng: np.random.Generator, w: int):
+        st = self.stats
+        st.attempted += w
+        T = tatp
+        ttype = rng.choice(7, size=w, p=wl.TATP_MIX).astype(np.int32)
+        s_id = wl.nurand(rng, wl.TATP_A, self.p, w).astype(np.int64)
+        xtype = rng.integers(1, 5, size=w)          # ai_type / sf_type
+        stime = rng.choice([0, 8, 16], size=w)
+
+        # ---- wave 1: up to 4 lanes per txn: (op, table, key) ---------------
+        K = 4
+        ops = np.zeros((w, K), np.int32)
+        tbl = np.zeros((w, K), np.int32)
+        key = np.zeros((w, K), np.int64)
+        # lane roles per txn for later phases
+        sf_idx = s_id * 4 + (xtype - 1)
+        ai_idx = s_id * 4 + (xtype - 1)
+        cfk = tatp.cf_key(s_id, xtype, stime)
+
+        def put(mask, lane, op, tb, k):
+            ops[mask, lane] = op
+            tbl[mask, lane] = tb
+            key[mask, lane] = k[mask]
+
+        t = ttype
+        m = t == wl.TATP_GET_SUBSCRIBER
+        put(m, 0, Op.OCC_READ, T.SUBSCRIBER, s_id)
+        m = t == wl.TATP_GET_ACCESS
+        put(m, 0, Op.OCC_READ, T.ACCESS_INFO, ai_idx)
+        m = t == wl.TATP_GET_NEW_DEST
+        put(m, 0, Op.OCC_READ, T.SPECIAL_FACILITY, sf_idx)
+        put(m, 1, Op.OCC_READ, T.CALL_FORWARDING, cfk)
+        m = t == wl.TATP_UPDATE_SUBSCRIBER
+        put(m, 0, Op.OCC_READ, T.SUBSCRIBER, s_id)
+        put(m, 1, Op.OCC_READ, T.SPECIAL_FACILITY, sf_idx)
+        put(m, 2, Op.OCC_LOCK, T.SUBSCRIBER, s_id)
+        put(m, 3, Op.OCC_LOCK, T.SPECIAL_FACILITY, sf_idx)
+        m = t == wl.TATP_UPDATE_LOCATION
+        put(m, 0, Op.OCC_READ, T.SEC_SUBSCRIBER, s_id)
+        put(m, 1, Op.OCC_READ, T.SUBSCRIBER, s_id)
+        put(m, 2, Op.OCC_LOCK, T.SUBSCRIBER, s_id)
+        m = t == wl.TATP_INSERT_CF
+        put(m, 0, Op.OCC_READ, T.SPECIAL_FACILITY, sf_idx)
+        put(m, 1, Op.OCC_READ, T.CALL_FORWARDING, cfk)
+        put(m, 2, Op.OCC_LOCK, T.CALL_FORWARDING, cfk)
+        m = t == wl.TATP_DELETE_CF
+        put(m, 0, Op.OCC_READ, T.CALL_FORWARDING, cfk)
+        put(m, 1, Op.OCC_LOCK, T.CALL_FORWARDING, cfk)
+
+        used = ops.reshape(-1) != 0
+        txn_of = np.repeat(np.arange(w), K)[used]
+        lane_of = np.tile(np.arange(K), w)[used]
+        rt, rv, rver = self._run_wave(ops.reshape(-1)[used],
+                                      tbl.reshape(-1)[used],
+                                      key.reshape(-1)[used])
+        # magic parity check on every VAL (tatp client asserts,
+        # client_ebpf_shard.cc:879-884)
+        isval = rt == Reply.VAL
+        assert (rv[isval, 1] == MAGIC).all(), "magic corrupted"
+
+        r_rt = np.full((w, K), -1, np.int32)
+        r_ver = np.zeros((w, K), np.uint32)
+        r_rt[txn_of, lane_of] = rt
+        r_ver[txn_of, lane_of] = rver
+
+        is_lock_lane = ops == Op.OCC_LOCK
+        lock_rejected = ((r_rt == Reply.REJECT) & is_lock_lane).any(1)
+
+        # required-row checks
+        missing = np.zeros(w, bool)
+        m = t == wl.TATP_GET_NEW_DEST     # sf must exist (cf optional)
+        missing |= m & (r_rt[:, 0] != Reply.VAL)
+        m = t == wl.TATP_UPDATE_SUBSCRIBER
+        missing |= m & ((r_rt[:, 0] != Reply.VAL) | (r_rt[:, 1] != Reply.VAL))
+        m = t == wl.TATP_UPDATE_LOCATION
+        missing |= m & ((r_rt[:, 0] != Reply.VAL) | (r_rt[:, 1] != Reply.VAL))
+        m = t == wl.TATP_INSERT_CF        # sf must exist; cf must NOT exist
+        missing |= m & ((r_rt[:, 0] != Reply.VAL) | (r_rt[:, 1] == Reply.VAL))
+        m = t == wl.TATP_DELETE_CF        # cf must exist
+        missing |= m & (r_rt[:, 0] != Reply.VAL)
+
+        is_ro = (t == wl.TATP_GET_SUBSCRIBER) | (t == wl.TATP_GET_ACCESS) | \
+                (t == wl.TATP_GET_NEW_DEST)
+        rw = ~is_ro
+        alive = rw & ~lock_rejected & ~missing
+        st.aborted_lock += int((rw & lock_rejected).sum())
+        st.aborted_missing += int((missing & ~(rw & lock_rejected)).sum())
+
+        # ---- wave 2: validate read-set (re-read, compare versions) ---------
+        # read-set lanes are the OCC_READ lanes of alive RW txns
+        is_read_lane = (ops == Op.OCC_READ) & alive[:, None]
+        v_used = is_read_lane.reshape(-1)
+        if v_used.any():
+            v_txn = np.repeat(np.arange(w), K)[v_used]
+            v_lane = np.tile(np.arange(K), w)[v_used]
+            vt, _, vver = self._run_wave(
+                np.full(v_used.sum(), Op.OCC_READ, np.int32),
+                tbl.reshape(-1)[v_used], key.reshape(-1)[v_used])
+            changed = np.zeros(w, bool)
+            # a row that vanished or changed version fails validation
+            bad = (vver != r_ver[v_txn, v_lane]) | \
+                  ((vt != Reply.VAL) & (r_rt[v_txn, v_lane] == Reply.VAL))
+            # for InsertCF the cf read was NOT_EXIST; it must STILL not exist
+            np.logical_or.at(changed, v_txn, bad)
+            st.aborted_validate += int((alive & changed).sum())
+            alive = alive & ~changed
+
+        # ---- commit waves --------------------------------------------------
+        # write-set per txn: (table, key, newval, kind) kind: 0=commit 1=insert 2=delete
+        wr_ops = {0: Op.COMMIT_PRIM, 1: Op.INSERT_PRIM, 2: Op.DELETE_PRIM}
+        bk_ops = {0: Op.COMMIT_BCK, 1: Op.INSERT_BCK, 2: Op.DELETE_BCK}
+        w_tb, w_key, w_kind, w_txn = [], [], [], []
+
+        def add_writes(mask, tb, k, kind):
+            idxs = np.nonzero(mask)[0]
+            w_tb.append(np.full(len(idxs), tb))
+            w_key.append(k[idxs])
+            w_kind.append(np.full(len(idxs), kind))
+            w_txn.append(idxs)
+
+        add_writes(alive & (t == wl.TATP_UPDATE_SUBSCRIBER), T.SUBSCRIBER, s_id, 0)
+        add_writes(alive & (t == wl.TATP_UPDATE_SUBSCRIBER), T.SPECIAL_FACILITY, sf_idx, 0)
+        add_writes(alive & (t == wl.TATP_UPDATE_LOCATION), T.SUBSCRIBER, s_id, 0)
+        add_writes(alive & (t == wl.TATP_INSERT_CF), T.CALL_FORWARDING, cfk, 1)
+        add_writes(alive & (t == wl.TATP_DELETE_CF), T.CALL_FORWARDING, cfk, 2)
+
+        if w_tb:
+            c_tb = np.concatenate(w_tb).astype(np.int32)
+            c_key = np.concatenate(w_key).astype(np.int64)
+            c_kind = np.concatenate(w_kind).astype(np.int32)
+            c_val = np.zeros((len(c_tb), self.vw), np.uint32)
+            c_val[:, 0] = rng.integers(0, 1 << 16, size=len(c_tb)).astype(np.uint32)
+            c_val[:, 1] = MAGIC
+            prim = (c_key % N_SHARDS).astype(np.int64)
+            log_op = np.where(c_kind == 2, Op.DELETE_LOG, Op.COMMIT_LOG).astype(np.int32)
+            for s in range(N_SHARDS):
+                self._run_wave(log_op, c_tb, c_key, np.full(len(c_tb), s), c_val)
+            bck = np.vectorize(bk_ops.get)(c_kind).astype(np.int32)
+            for off in (1, 2):
+                self._run_wave(bck, c_tb, c_key, (prim + off) % N_SHARDS, c_val)
+            pr = np.vectorize(wr_ops.get)(c_kind).astype(np.int32)
+            prt, _, _ = self._run_wave(pr, c_tb, c_key, prim, c_val)
+            assert (prt != Reply.NONE).all()
+
+        # ---- abort unlocks: granted locks of dead RW txns -------------------
+        dead = rw & ~alive
+        ab_lane = is_lock_lane & (r_rt == Reply.GRANT) & dead[:, None]
+        a_used = ab_lane.reshape(-1)
+        if a_used.any():
+            self._run_wave(np.full(a_used.sum(), Op.ABORT, np.int32),
+                           tbl.reshape(-1)[a_used], key.reshape(-1)[a_used])
+
+        st.committed += int((is_ro & ~missing).sum() + alive.sum())
+        return st

@@ -1,0 +1,93 @@
+"""Workload generators: txn mixes, skewed key sampling, trace generation.
+
+Ports of the reference's generators:
+  * SmallBank mix 15/15/15/25/15/15 with 90% of txns on a 4% hot set
+    (smallbank/caladan/smallbank.h:16-18,29-50,63-69)
+  * TATP mix 35/35/10/2/14/2/2 with NURand subscriber ids, A=1048575
+    (tatp/caladan/tatp.h:40-43,57-63)
+  * 2PL/FaSST lock traces: 20k txns x 5-10 sorted locks, read-prop 0.8
+    (lock_2pl/caladan/trace_init.sh:6-25)
+"""
+from __future__ import annotations
+
+import numpy as np
+
+# ---------------------------------------------------------------- smallbank
+
+SB_AMALGAMATE = 0
+SB_BALANCE = 1
+SB_DEPOSIT = 2
+SB_SEND_PAYMENT = 3
+SB_TRANSACT_SAVING = 4
+SB_WRITE_CHECK = 5
+
+# mix percentages, smallbank/caladan/smallbank.h:63-69
+SB_MIX = np.array([15, 15, 15, 25, 15, 15], np.float64) / 100.0
+SB_MAGIC = 0x5B5B
+SB_HOT_FRAC = 0.04        # 960k of 24M accounts
+SB_HOT_PROB = 0.9         # 90% of txns hit the hot set
+
+
+def sb_sample_accounts(rng: np.random.Generator, n: int, n_accounts: int,
+                       hot_frac: float = SB_HOT_FRAC,
+                       hot_prob: float = SB_HOT_PROB):
+    """Skewed account sampling: hot set = first hot_frac of the keyspace."""
+    hot_n = max(int(n_accounts * hot_frac), 1)
+    is_hot = rng.random(n) < hot_prob
+    return np.where(is_hot,
+                    rng.integers(0, hot_n, size=n),
+                    rng.integers(0, n_accounts, size=n)).astype(np.int64)
+
+
+def sb_make_txns(rng: np.random.Generator, n: int, n_accounts: int,
+                 mix=SB_MIX, **skew):
+    """Generate a cohort of SmallBank txns: (type [n], a1 [n], a2 [n])."""
+    ttype = rng.choice(6, size=n, p=mix).astype(np.int32)
+    a1 = sb_sample_accounts(rng, n, n_accounts, **skew)
+    a2 = sb_sample_accounts(rng, n, n_accounts, **skew)
+    # two-account txns need distinct accounts
+    clash = (a1 == a2)
+    a2 = np.where(clash, (a2 + 1) % n_accounts, a2)
+    return ttype, a1, a2
+
+
+# ---------------------------------------------------------------- tatp
+
+TATP_GET_SUBSCRIBER = 0
+TATP_GET_ACCESS = 1
+TATP_GET_NEW_DEST = 2
+TATP_UPDATE_SUBSCRIBER = 3
+TATP_UPDATE_LOCATION = 4
+TATP_INSERT_CF = 5
+TATP_DELETE_CF = 6
+
+# mix percentages, tatp/caladan/tatp.h:57-63
+TATP_MIX = np.array([35, 35, 10, 2, 14, 2, 2], np.float64) / 100.0
+TATP_A = 1048575  # NURand A, tatp/caladan/tatp.h:40-43
+
+
+def nurand(rng: np.random.Generator, a: int, n: int, size: int):
+    """TATP non-uniform subscriber id in [1, n] (tatp/caladan/tatp.h:40-43)."""
+    x = rng.integers(0, a + 1, size=size)
+    y = rng.integers(1, n + 1, size=size)
+    return ((x | y) % n) + 1
+
+
+# ---------------------------------------------------------------- lock traces
+
+
+def lock_trace(rng: np.random.Generator, n_txns: int = 20_000,
+               locks_per_txn=(5, 10), key_range: int = 4800,
+               read_prop: float = 0.8):
+    """2PL/FaSST trace: per txn, 5-10 distinct keys in sorted order with
+    per-key read/write mode (lock_2pl/caladan/trace_init.sh:6-25).
+
+    Returns list of (keys [k] int64 ascending, is_read [k] bool).
+    """
+    txns = []
+    for _ in range(n_txns):
+        k = int(rng.integers(locks_per_txn[0], locks_per_txn[1] + 1))
+        keys = np.sort(rng.choice(key_range, size=k, replace=False))
+        is_read = rng.random(k) < read_prop
+        txns.append((keys.astype(np.int64), is_read))
+    return txns

@@ -47,8 +47,13 @@ def step(table: kv.KVTable, batch: Batch, *, maintain_bloom: bool = False):
     op = batch.op[sb.perm]
     val_in = batch.val[sb.perm]
 
-    bkt = hashing.bucket(sb.key_hi, sb.key_lo, table.n_buckets)
-    hit0, slot0, val0, ver0 = kv.probe(table, sb.key_hi, sb.key_lo, bkt)
+    b1, b2 = hashing.bucket_pair(sb.key_hi, sb.key_lo, table.n_buckets)
+    hit0, fbkt, slot0, val0, ver0, free1, free2 = kv.probe(
+        table, sb.key_hi, sb.key_lo, b1, b2)
+    # insert destination: the emptier of the two candidate buckets
+    dest = jnp.where(free2 > free1, b2, b1)
+    bkt = jnp.where(hit0, fbkt, dest)
+    alt = jnp.where(hit0, fbkt, b1 + b2 - dest)   # the other candidate
 
     is_get = op == Op.GET
     is_install = (op == Op.SET) | (op == Op.INSERT)
@@ -91,8 +96,8 @@ def step(table: kv.KVTable, batch: Batch, *, maintain_bloom: bool = False):
     w_del = writer & ~final_exists & hit0
 
     # back to original order for phase B + scatters
-    (o_upd, o_alloc, o_del, o_bkt, o_slot0, o_ver) = segments.unsort(
-        sb, w_upd, w_alloc, w_del, bkt, slot0, final_ver)
+    (o_upd, o_alloc, o_del, o_bkt, o_alt, o_slot0, o_ver) = segments.unsort(
+        sb, w_upd, w_alloc, w_del, bkt, alt, slot0, final_ver)
     o_val = segments.unsort(sb, last_val)
     o_khi, o_klo = segments.unsort(sb, sb.key_hi, sb.key_lo)
 
@@ -104,7 +109,23 @@ def step(table: kv.KVTable, batch: Batch, *, maintain_bloom: bool = False):
     has2, slot_new2 = kv.nth_free_slot(table.valid[bkt2], rank_alloc)
     ok2 = alloc2 & has2
     spill2 = alloc2 & ~has2
-    ok, spill, slot_new = segments.unsort(sb2, ok2, spill2, slot_new2)
+    ok, spill1, slot_new = segments.unsort(sb2, ok2, spill2, slot_new2)
+
+    # ---- phase B2: overflow retries its ALTERNATE candidate bucket --------
+    # (two-choice insert: only give up when both buckets are full). Ranks in
+    # the alternate must skip slots phase B just handed out there.
+    taken = jnp.zeros((table.n_buckets + 1,), I32).at[
+        jnp.where(ok, o_bkt, table.n_buckets)].add(1, mode="drop")
+    sb3 = segments.sort_batch(jnp.zeros((r,), U32), o_alt.astype(U32))
+    retry3 = spill1[sb3.perm]
+    rank3 = segments.seg_cumsum_excl(sb3, retry3.astype(I32)) + taken[o_alt[sb3.perm]]
+    has3, slot_new3 = kv.nth_free_slot(table.valid[o_alt[sb3.perm]], rank3)
+    ok3_s = retry3 & has3
+    ok_alt, slot_alt = segments.unsort(sb3, ok3_s, slot_new3)
+    spill = spill1 & ~ok_alt
+    ok = ok | ok_alt
+    o_bkt = jnp.where(ok_alt, o_alt, o_bkt)
+    slot_new = jnp.where(ok_alt, slot_alt, slot_new)
 
     # spill => every install of that key failed: fix up replies for the whole
     # key segment (installs -> SPILL, deletes -> NOT_EXIST since nothing was

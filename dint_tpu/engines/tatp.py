@@ -1,0 +1,244 @@
+"""TATP shard server engine: OCC + replication over 5 tables.
+
+TPU equivalent of the reference's TATP txn server
+(tatp/ebpf/shard_kern.c): READ with bloom (:140-250), ACQUIRE_LOCK CAS
+(:251-297), ABORT (:298-337), COMMIT_PRIM installs + releases the row lock
+(:338-476), INSERT/DELETE_PRIM (:477-658), COMMIT/INSERT/DELETE_BCK
+(:659-913), COMMIT_LOG/DELETE_LOG (:914-939).
+
+Table layout (TPU-first: dense-index what the reference hashes):
+  SUBSCRIBER(0)        dense by s_id, exact per-row OCC lock
+  SEC_SUBSCRIBER(1)    dense by sub_nbr
+  ACCESS_INFO(2)       dense by s_id*4 + (ai_type-1); ver==0 means absent
+  SPECIAL_FACILITY(3)  dense by s_id*4 + (sf_type-1), per-row lock
+  CALL_FORWARDING(4)   sparse composite key (s_id, sf_type, start_time)
+                       -> tables.kv.KVTable with insert/delete + bloom,
+                       row locks hash-conflated in a tables.locks.OCCTable
+                       (exactly the reference's lock-array shape,
+                       tatp/ebpf/shard_kern.c:26-59)
+
+The CF table is processed by *reusing* engines.store.step (KV semantics:
+GET/SET/INSERT/DELETE with SPILL) and engines.fasst.step (lock word CAS),
+each on a derived op view of the batch; dense tables get a closed-form OCC
+pass (commits/unlocks, then reads, then lock acquires — per (table, row)).
+
+Versions auto-increment server-side on install (store.step semantics); since
+every replica applies the same certified ops, replicas stay bit-identical
+without client-supplied versions.
+"""
+from __future__ import annotations
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from ..ops import segments
+from ..tables import dense, kv, locks, log as logring
+from . import fasst, store
+from .types import Batch, Op, Replies, Reply
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+SUBSCRIBER = 0
+SEC_SUBSCRIBER = 1
+ACCESS_INFO = 2
+SPECIAL_FACILITY = 3
+CALL_FORWARDING = 4
+
+N_DENSE = 4
+
+
+def cf_key(s_id, sf_type, start_time):
+    """Composite CALL_FORWARDING key -> u64 (start_time in {0, 8, 16})."""
+    return s_id * 12 + (sf_type - 1) * 3 + start_time // 8
+
+
+@flax.struct.dataclass
+class Shard:
+    sub: dense.DenseTable
+    sec: dense.DenseTable
+    ai: dense.DenseTable
+    sf: dense.DenseTable
+    sub_lock: jax.Array   # bool [P+1]
+    sec_lock: jax.Array
+    ai_lock: jax.Array    # bool [4(P+1)]
+    sf_lock: jax.Array
+    cf: kv.KVTable
+    cf_lock: locks.OCCTable
+    log: logring.LogRing
+
+    @property
+    def n_subscribers(self):
+        return self.sub.size - 1
+
+
+def create(n_subscribers: int, val_words: int = 10, cf_buckets: int | None = None,
+           cf_lock_slots: int | None = None, log_lanes: int = 16,
+           log_capacity: int = 1 << 20) -> Shard:
+    p1 = n_subscribers + 1          # ids are 1-based
+    if cf_buckets is None:
+        cf_buckets = max(1 << (p1 * 4).bit_length(), 16)  # ~load<=0.25 at 4 slots
+    if cf_lock_slots is None:
+        cf_lock_slots = max(cf_buckets, 16)
+    return Shard(
+        sub=dense.create(p1, val_words),
+        sec=dense.create(p1, val_words),
+        ai=dense.create(4 * p1, val_words),
+        sf=dense.create(4 * p1, val_words),
+        sub_lock=jnp.zeros((p1,), bool),
+        sec_lock=jnp.zeros((p1,), bool),
+        ai_lock=jnp.zeros((4 * p1,), bool),
+        sf_lock=jnp.zeros((4 * p1,), bool),
+        cf=kv.create(cf_buckets, slots=4, val_words=val_words),
+        cf_lock=locks.create_occ(cf_lock_slots),
+        log=logring.create(log_lanes, log_capacity, val_words),
+    )
+
+
+# --------------------------------------------------------------- dense OCC
+
+
+def _dense_gather(shard: Shard, tbl, idx):
+    """Gather (val, ver, locked) for dense tables 0..3, OOB-safe."""
+    def pick(t: dense.DenseTable, lock, n):
+        i = jnp.clip(idx, 0, n - 1)
+        return t.val[i], t.ver[i], lock[i]
+
+    v0, r0, l0 = pick(shard.sub, shard.sub_lock, shard.sub.size)
+    v1, r1, l1 = pick(shard.sec, shard.sec_lock, shard.sec.size)
+    v2, r2, l2 = pick(shard.ai, shard.ai_lock, shard.ai.size)
+    v3, r3, l3 = pick(shard.sf, shard.sf_lock, shard.sf.size)
+    val = jnp.where((tbl == 0)[:, None], v0,
+          jnp.where((tbl == 1)[:, None], v1,
+          jnp.where((tbl == 2)[:, None], v2, v3)))
+    ver = jnp.where(tbl == 0, r0, jnp.where(tbl == 1, r1, jnp.where(tbl == 2, r2, r3)))
+    lck = jnp.where(tbl == 0, l0, jnp.where(tbl == 1, l1, jnp.where(tbl == 2, l2, l3)))
+    return val, ver, lck
+
+
+def _dense_step(shard: Shard, batch: Batch):
+    """Closed-form OCC pass over the four dense tables.
+
+    Per (table, row) group: commit installs + unlocks first, then aborts'
+    unlocks, then reads (seeing post-commit state), then lock acquires in
+    lane order. ver==0 rows are absent (NOT_EXIST on read; commits create).
+    """
+    r = batch.width
+    is_dense = batch.table < N_DENSE
+    op = jnp.where(is_dense, batch.op, Op.NOP)
+    sb = segments.sort_batch(batch.table.astype(U32), batch.key_lo)
+    op = op[sb.perm]
+    val_in = batch.val[sb.perm]
+    tbl = sb.key_hi.astype(I32)
+    idx = sb.key_lo.astype(I32)
+
+    val0, ver0, locked0 = _dense_gather(shard, tbl, idx)
+
+    is_cprim = op == Op.COMMIT_PRIM
+    is_cbck = op == Op.COMMIT_BCK
+    is_commit = is_cprim | is_cbck
+    is_abort = op == Op.ABORT
+    is_read = op == Op.OCC_READ
+    is_lock = op == Op.OCC_LOCK
+
+    # commits install (last by lane order wins; X-certified so one per row)
+    last_c = segments.seg_max_where(sb, is_commit, sb.rank, I32(-1))
+    pos_c = jnp.clip(sb.head_pos + last_c, 0, r - 1)
+    any_c = last_c >= 0
+    n_c = segments.seg_sum(sb, is_commit.astype(I32))
+    val1 = jnp.where(any_c[:, None], val_in[pos_c], val0)
+    ver1 = jnp.where(any_c, ver0 + n_c.astype(U32), ver0)
+    unlock = segments.seg_any(sb, is_cprim | is_abort)
+    locked1 = locked0 & ~unlock
+
+    first_lock = segments.first_rank_where(sb, is_lock)
+    grant = is_lock & ~locked1 & (sb.rank == first_lock)
+    new_locked = locked1 | segments.seg_any(sb, grant)
+
+    exists = ver1 > 0
+    rtype = jnp.full((r,), Reply.NONE, I32)
+    rtype = jnp.where(is_commit | is_abort, Reply.ACK, rtype)
+    rtype = jnp.where(is_read, jnp.where(exists, Reply.VAL, Reply.NOT_EXIST), rtype)
+    rtype = jnp.where(is_lock, jnp.where(grant, Reply.GRANT, Reply.REJECT), rtype)
+    rval = jnp.where((is_read & exists)[:, None], val1, jnp.zeros_like(val1))
+    rver = jnp.where(is_read & exists, ver1, U32(0))
+
+    writer = sb.last & segments.seg_any(sb, op != Op.NOP)
+
+    def scat(t: dense.DenseTable, lock, n, which):
+        m = writer & (tbl == which)
+        i = jnp.clip(idx, 0, n - 1)
+        return t.replace(
+            val=segments.scatter_rows(t.val, i, val1, m),
+            ver=segments.scatter_rows(t.ver, i, ver1, m),
+        ), segments.scatter_rows(lock, i, new_locked, m)
+
+    new_sub, new_sub_l = scat(shard.sub, shard.sub_lock, shard.sub.size, 0)
+    new_sec, new_sec_l = scat(shard.sec, shard.sec_lock, shard.sec.size, 1)
+    new_ai, new_ai_l = scat(shard.ai, shard.ai_lock, shard.ai.size, 2)
+    new_sf, new_sf_l = scat(shard.sf, shard.sf_lock, shard.sf.size, 3)
+    shard = shard.replace(sub=new_sub, sub_lock=new_sub_l, sec=new_sec,
+                          sec_lock=new_sec_l, ai=new_ai, ai_lock=new_ai_l,
+                          sf=new_sf, sf_lock=new_sf_l)
+    o_rtype, o_rver = segments.unsort(sb, rtype, rver)
+    o_rval = segments.unsort(sb, rval)
+    return shard, Replies(rtype=o_rtype, val=o_rval, ver=o_rver)
+
+
+# --------------------------------------------------------------- CF (sparse)
+
+_KV_OP = {Op.OCC_READ: Op.GET, Op.COMMIT_PRIM: Op.SET, Op.COMMIT_BCK: Op.SET,
+          Op.INSERT_PRIM: Op.INSERT, Op.INSERT_BCK: Op.INSERT,
+          Op.DELETE_PRIM: Op.DELETE, Op.DELETE_BCK: Op.DELETE}
+_UNLOCK_OPS = (Op.COMMIT_PRIM, Op.INSERT_PRIM, Op.DELETE_PRIM, Op.ABORT)
+
+
+def _translate(op, table, mapping, default=Op.NOP):
+    out = jnp.full_like(op, default)
+    for src, dst in mapping.items():
+        out = jnp.where((table == CALL_FORWARDING) & (op == src), dst, out)
+    return out
+
+
+def _cf_step(shard: Shard, batch: Batch):
+    """CALL_FORWARDING pass: store.step handles the KV mutations, fasst.step
+    handles the hash-slot row locks; prim ops appear in both views (install
+    in the KV view, unlock in the lock view)."""
+    kv_ops = _translate(batch.op, batch.table, _KV_OP)
+    new_cf, kv_rep = store.step(shard.cf, batch.replace(op=kv_ops),
+                                maintain_bloom=True)
+    lock_map = {Op.OCC_LOCK: Op.LOCK}
+    for o in _UNLOCK_OPS:
+        lock_map[o] = Op.ABORT
+    lk_ops = _translate(batch.op, batch.table, lock_map)
+    new_cf_lock, lk_rep = fasst.step(shard.cf_lock, batch.replace(op=lk_ops))
+    shard = shard.replace(cf=new_cf, cf_lock=new_cf_lock)
+    # lock replies only for OCC_LOCK lanes; everything else from the KV view
+    use_lock = (batch.table == CALL_FORWARDING) & (batch.op == Op.OCC_LOCK)
+    rep = Replies(
+        rtype=jnp.where(use_lock, lk_rep.rtype, kv_rep.rtype),
+        val=kv_rep.val,
+        ver=jnp.where(use_lock, lk_rep.ver, kv_rep.ver),
+    )
+    return shard, rep
+
+
+def step(shard: Shard, batch: Batch):
+    """Certify and apply one batch (all 5 tables + log). Returns (shard', replies)."""
+    shard, dense_rep = _dense_step(shard, batch)
+    shard, cf_rep = _cf_step(shard, batch)
+
+    do_log = (batch.op == Op.COMMIT_LOG) | (batch.op == Op.DELETE_LOG)
+    new_log, _, _ = logring.append(
+        shard.log, do_log, batch.table,
+        (batch.op == Op.DELETE_LOG).astype(I32),
+        batch.key_hi, batch.key_lo, batch.ver, batch.val)
+    shard = shard.replace(log=new_log)
+
+    is_cf = batch.table == CALL_FORWARDING
+    rtype = jnp.where(is_cf, cf_rep.rtype, dense_rep.rtype)
+    rtype = jnp.where(do_log, I32(Reply.ACK), rtype)
+    rval = jnp.where(is_cf[:, None], cf_rep.val, dense_rep.val)
+    rver = jnp.where(is_cf, cf_rep.ver, dense_rep.ver)
+    return shard, Replies(rtype=rtype, val=rval, ver=rver)

@@ -65,6 +65,27 @@ def hash64_np(key: np.ndarray) -> np.ndarray:
     return mix(h)
 
 
+def bucket_pair(key_hi, key_lo, n_buckets: int):
+    """key -> two independent bucket choices (power-of-two-choices hashing).
+
+    Uses disjoint bits of one fasthash64 evaluation: low word for the first
+    choice, high word for the second — zero extra hash cost. Requires
+    n_buckets <= 2^26 so the second choice stays clear of the bloom bits
+    (which use the hash's top 6 bits).
+    """
+    assert n_buckets & (n_buckets - 1) == 0 and n_buckets <= (1 << 26)
+    hi, lo = hash64(key_hi, key_lo)
+    return ((lo & U32(n_buckets - 1)).astype(jnp.int32),
+            (hi & U32(n_buckets - 1)).astype(jnp.int32))
+
+
+def bucket_pair_np(key, n_buckets: int):
+    assert n_buckets & (n_buckets - 1) == 0 and n_buckets <= (1 << 26)
+    h = hash64_np(key)
+    return ((h & np.uint64(n_buckets - 1)).astype(np.int64),
+            ((h >> np.uint64(32)) & np.uint64(n_buckets - 1)).astype(np.int64))
+
+
 def bucket(key_hi, key_lo, n_buckets: int):
     """key -> bucket index in [0, n_buckets); n_buckets must be a power of 2."""
     assert n_buckets & (n_buckets - 1) == 0, "n_buckets must be a power of two"

@@ -1,0 +1,166 @@
+"""Multi-chip sharding: partitioned keyspaces + device-side replication.
+
+TPU re-expression of the reference's distribution machinery
+(SURVEY.md §2.3): static hash sharding of the keyspace across 3 servers
+(`shard = key % 3`, tatp/caladan/client_ebpf_shard.cc:636-641) and
+primary-backup replication (every record on 3 servers; primary = key % n,
+backups +1, +2; CommitLog -> all, CommitBck -> backups, CommitPrim ->
+primary).
+
+Here the "servers" are TPU devices on a `jax.sharding.Mesh` axis:
+
+  * the keyspace is partitioned owner = key % n_shards; each device's engine
+    state holds 3 *roles* of each of its dense rows — role 0 = rows it owns
+    (primary), roles 1, 2 = replicas of devices d-1, d-2 — via the local
+    index remap (key // n) * 3 + role. Sparse (hash) tables keep global keys
+    and just size for 3/n of the keyspace.
+  * clients route primary ops to the owner (host pre-bucketing, exactly like
+    the reference client's per-shard batches).
+  * replication happens ON DEVICE: after the primary step, commit records
+    are forwarded to the +1/+2 neighbors with `ppermute` over ICI and applied
+    there as backup installs — replacing the reference's client-driven
+    CommitBck fan-out RTTs.
+  * the per-step committed count is `psum`med across the mesh — the batched
+    equivalent of 2PC vote collection.
+
+Everything runs under `shard_map` over one jitted step; tested on a virtual
+8-device CPU mesh (tests/conftest.py) and dry-run by the driver via
+__graft_entry__.dryrun_multichip.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..engines import tatp
+from ..engines.types import Batch, Op, Replies
+from ..ops import segments
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+N_ROLES = 3
+SHARD_AXIS = "shard"
+
+
+def make_mesh(n_devices: int | None = None) -> Mesh:
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    return Mesh(np.array(devs[:n]), (SHARD_AXIS,))
+
+
+def local_rows(n_global: int, n_shards: int) -> int:
+    """Dense rows per device: 3 roles x ceil(n_global / n_shards)."""
+    return N_ROLES * ((n_global + n_shards - 1) // n_shards)
+
+
+def local_dense_key(global_key, n_shards: int, role: int):
+    """Global dense key -> device-local row for the given replica role."""
+    return (global_key // n_shards) * N_ROLES + role
+
+
+_PRIM_TO_BCK = {Op.COMMIT_PRIM: Op.COMMIT_BCK, Op.INSERT_PRIM: Op.INSERT_BCK,
+                Op.DELETE_PRIM: Op.DELETE_BCK}
+
+
+def _as_backup_ops(op):
+    out = jnp.full_like(op, Op.NOP)
+    for src, dst in _PRIM_TO_BCK.items():
+        out = jnp.where(op == src, dst, out)
+    return out
+
+
+def _remap_dense_keys(batch: Batch, n_shards: int, role: int) -> Batch:
+    """Remap dense-table keys in a batch to this device's local rows."""
+    is_dense = batch.table < tatp.N_DENSE
+    lk = local_dense_key(batch.key_lo.astype(I32), n_shards, role)
+    return batch.replace(key_lo=jnp.where(is_dense, lk.astype(U32), batch.key_lo))
+
+
+def replicated_step(shard: tatp.Shard, batch: Batch, *, n_shards: int):
+    """One multi-chip TATP step, called inside shard_map.
+
+    `batch` holds this device's primary-routed requests with GLOBAL keys.
+    Applies the primary step locally, ppermutes commit records to the two
+    backup neighbors, applies received backups, and psums the commit vote.
+    Returns (shard', replies, global_committed).
+    """
+    shard, replies = tatp.step(shard, _remap_dense_keys(batch, n_shards, 0))
+
+    # forward this device's prim-commit records to backups d+1, d+2
+    is_prim = ((batch.op == Op.COMMIT_PRIM) | (batch.op == Op.INSERT_PRIM)
+               | (batch.op == Op.DELETE_PRIM))
+    bck_op = _as_backup_ops(batch.op)
+    for off in (1, 2):
+        perm = [(i, (i + off) % n_shards) for i in range(n_shards)]
+        pp = functools.partial(jax.lax.ppermute, axis_name=SHARD_AXIS, perm=perm)
+        fwd = Batch(op=pp(bck_op), table=pp(batch.table),
+                    key_hi=pp(batch.key_hi), key_lo=pp(batch.key_lo),
+                    val=pp(batch.val), ver=pp(batch.ver))
+        # received records came from the device `off` behind us -> role `off`
+        shard, _ = tatp.step(shard, _remap_dense_keys(fwd, n_shards, off))
+
+    committed = jax.lax.psum(is_prim.sum().astype(I32), SHARD_AXIS)
+    return shard, replies, committed
+
+
+def build_sharded_step(mesh: Mesh, n_shards: int):
+    """jit(shard_map(replicated_step)) over stacked per-device state.
+
+    State/batch arrays carry a leading [n_shards] device axis sharded over
+    the mesh; inside shard_map each device sees its own [1, ...] block.
+    """
+    def squeeze(tree):
+        return jax.tree.map(lambda x: x[0], tree)
+
+    def unsqueeze(tree):
+        return jax.tree.map(lambda x: x[None], tree)
+
+    def local_fn(shard_blk, batch_blk):
+        shard, replies, committed = replicated_step(
+            squeeze(shard_blk), squeeze(batch_blk), n_shards=n_shards)
+        return unsqueeze(shard), unsqueeze(replies), committed[None]
+
+    fn = jax.shard_map(local_fn, mesh=mesh,
+                       in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+                       out_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)))
+    return jax.jit(fn)
+
+
+def create_sharded_state(mesh: Mesh, n_shards: int, n_subscribers: int,
+                         val_words: int = 10, **kw) -> tatp.Shard:
+    """Stacked per-device TATP state, device-local table sizes, sharded
+    over the mesh (leading axis = device)."""
+    rows = local_rows(n_subscribers + 1, n_shards)
+    proto = tatp.create(rows - 1, val_words=val_words, **kw)
+
+    def stack(x):
+        stacked = jnp.broadcast_to(x[None], (n_shards,) + x.shape)
+        return jax.device_put(stacked, NamedSharding(mesh, P(SHARD_AXIS)))
+
+    return jax.tree.map(stack, proto)
+
+
+def route_batches(ops, tbls, keys, vals, vers, n_shards: int, width: int,
+                  val_words: int):
+    """Host-side: bucket flat request arrays by owner = key % n_shards into a
+    stacked [n_shards, width] Batch (the reference client's per-shard batch
+    grouping, smallbank/caladan/client_ebpf_shard.cc:287-289)."""
+    from ..engines.types import make_batch
+
+    owner = (np.asarray(keys, np.int64) % n_shards)
+    parts = []
+    for d in range(n_shards):
+        idx = np.nonzero(owner == d)[0]
+        assert len(idx) <= width, "per-device batch overflow"
+        parts.append(make_batch(ops[idx], keys[idx].astype(np.uint64),
+                                vals[idx] if vals is not None else None,
+                                vers=vers[idx] if vers is not None else None,
+                                tables=tbls[idx], width=width,
+                                val_words=val_words))
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *parts)
+    return stacked, owner

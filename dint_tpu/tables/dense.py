@@ -1,0 +1,46 @@
+"""Dense tables: direct-indexed value/version arrays for dense keyspaces.
+
+The reference hashes *every* table because its kvs.h is generic
+(store/ebpf/kvs.h), even though SmallBank accounts (0..N-1,
+smallbank/ebpf/smallbank.h:20-66) and TATP subscriber ids (1..P,
+tatp/caladan/tatp.h:28) are dense integers. On TPU, dense keys index HBM
+arrays directly — no probe, no buckets, no collisions, and per-record locks
+become exact instead of hash-conflated. Sparse/composite-key tables
+(e.g. TATP CALL_FORWARDING) still use tables.kv.KVTable.
+"""
+from __future__ import annotations
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+
+@flax.struct.dataclass
+class DenseTable:
+    val: jax.Array   # u32 [N, VW]
+    ver: jax.Array   # u32 [N]
+
+    @property
+    def size(self):
+        return self.ver.shape[0]
+
+    @property
+    def val_words(self):
+        return self.val.shape[1]
+
+
+def create(n: int, val_words: int) -> DenseTable:
+    return DenseTable(val=jnp.zeros((n, val_words), U32),
+                      ver=jnp.zeros((n,), U32))
+
+
+def populate(table: DenseTable, vals: np.ndarray, vers=None) -> DenseTable:
+    vals = np.asarray(vals, np.uint32)
+    assert vals.shape == table.val.shape
+    if vers is None:
+        vers = np.ones(table.size, np.uint32)
+    return DenseTable(val=jnp.asarray(vals), ver=jnp.asarray(vers))

@@ -68,30 +68,45 @@ def create(n_buckets: int, slots: int = 4, val_words: int = 10) -> KVTable:
     )
 
 
-def probe(table: KVTable, key_hi, key_lo, bkt):
-    """Find each key's slot in its bucket.
-
-    Returns (hit [R] bool, slot [R] i32, val [R, VW], ver [R]) against the
-    table's current state. ``slot`` is arbitrary when not hit.
-    """
+def _match_bucket(table: KVTable, key_hi, key_lo, bkt):
     rows_hi = table.key_hi[bkt]          # [R, S]
     rows_lo = table.key_lo[bkt]
     rows_valid = table.valid[bkt]
     match = rows_valid & (rows_hi == key_hi[:, None]) & (rows_lo == key_lo[:, None])
-    hit = match.any(axis=-1)
-    slot = jnp.argmax(match, axis=-1).astype(I32)
+    free = (~rows_valid).sum(axis=-1).astype(I32)
+    return match.any(axis=-1), jnp.argmax(match, axis=-1).astype(I32), free
+
+
+def probe(table: KVTable, key_hi, key_lo, b1, b2):
+    """Two-choice probe: find each key in either of its two candidate buckets.
+
+    Returns (hit [R] bool, bkt [R] i32, slot [R] i32, val [R, VW], ver [R],
+    free1 [R] i32, free2 [R] i32). ``bkt``/``slot`` are the key's actual
+    location when hit, arbitrary otherwise; free1/free2 are the candidate
+    buckets' free-slot counts (reusing the gathers the probe already did).
+    A key lives in at most one bucket (insert picks one).
+    """
+    hit1, slot1, free1 = _match_bucket(table, key_hi, key_lo, b1)
+    hit2, slot2, free2 = _match_bucket(table, key_hi, key_lo, b2)
+    hit = hit1 | hit2
+    bkt = jnp.where(hit1, b1, b2)
+    slot = jnp.where(hit1, slot1, slot2)
     val = table.val[bkt, slot]
     ver = table.ver[bkt, slot]
-    return hit, slot, val, ver
+    return hit, bkt, slot, val, ver, free1, free2
 
 
-def bloom_maybe(table: KVTable, key_hi, key_lo, bkt):
-    """True if the bucket's bloom filter admits the key (possibly present)."""
+def bloom_maybe(table: KVTable, key_hi, key_lo, b1, b2):
+    """True if either candidate bucket's bloom admits the key (one hash)."""
     bit = hashing.bloom_bit(key_hi, key_lo)           # [R] in [0, 64)
     use_hi = bit >= 32
-    word = jnp.where(use_hi, table.bloom_hi[bkt], table.bloom_lo[bkt])
     shift = jnp.where(use_hi, bit - 32, bit).astype(U32)
-    return ((word >> shift) & U32(1)) == U32(1)
+
+    def hit(b):
+        word = jnp.where(use_hi, table.bloom_hi[b], table.bloom_lo[b])
+        return ((word >> shift) & U32(1)) == U32(1)
+
+    return hit(b1) | hit(b2)
 
 
 def nth_free_slot(valid_rows, rank):
@@ -146,13 +161,59 @@ def to_dict(table: KVTable) -> dict:
             for k, v, ver in zip(keys, vals, vers)}
 
 
+def _within_bucket_rank(bkt, priority=None):
+    """Rank of each key within its bucket; `priority` randomizes which keys
+    count as the overflow (essential for cuckoo rebalancing: victims must be
+    random, or high-priority keys ping-pong without displacing residents)."""
+    if priority is not None:
+        order = np.lexsort((priority, bkt))
+    else:
+        order = np.argsort(bkt, kind="stable")
+    sorted_bkt = bkt[order]
+    start = np.concatenate([[True], sorted_bkt[1:] != sorted_bkt[:-1]])
+    idx = np.arange(len(bkt))
+    within_sorted = idx - np.maximum.accumulate(np.where(start, idx, 0))
+    within = np.empty(len(bkt), np.int64)
+    within[order] = within_sorted
+    return within
+
+
+def assign_two_choice(keys: np.ndarray, n_buckets: int, slots: int,
+                      max_iters: int = 200):
+    """Offline two-choice placement: per key, pick one of its two candidate
+    buckets so no bucket exceeds `slots`. Parallel random-walk cuckoo:
+    each iteration, keys that overflow their bucket flip to their alternate
+    (with random damping), displacing others, until no bucket overflows.
+    Converges comfortably up to ~0.85 load with 4-slot buckets (the parallel
+    random walk slows well short of the (2,4)-cuckoo feasibility threshold of
+    ~0.98) — far beyond single-choice hashing's Poisson tail. Size production
+    tables at <= 0.75 load.
+
+    Returns (bkt [N], slot [N]); raises if it cannot converge.
+    """
+    keys = np.asarray(keys, np.uint64)
+    b1, b2 = hashing.bucket_pair_np(keys, n_buckets)
+    rng = np.random.default_rng(0xD1A7)
+    choice = np.zeros(len(keys), bool)   # False -> b1
+    for _ in range(max_iters):
+        cur = np.where(choice, b2, b1)
+        within = _within_bucket_rank(cur, priority=rng.random(len(keys)))
+        over = within >= slots
+        if not over.any():
+            return cur, within
+        flip = over & (rng.random(len(keys)) < 0.7)
+        choice ^= flip
+    raise ValueError("two-choice placement did not converge: table too small")
+
+
 def populate(table: KVTable, keys: np.ndarray, vals: np.ndarray,
              vers: np.ndarray | None = None) -> KVTable:
     """Bulk-load a table host-side (numpy), like the reference's populate
     phase (smallbank/ebpf/shard_user.c:74-77, tatp/caladan/server_shard.cc:56-70).
 
-    Raises if a bucket overflows — table sizing must cover the keyspace,
-    mirroring e.g. SAV_HASH_SIZE = ACCOUNT_NUM*3/2/4 (smallbank/ebpf/utils.h:16-17).
+    Two-choice placement; raises if the table genuinely cannot hold the
+    keyspace (the reference instead sizes ad hoc, e.g. SAV_HASH_SIZE =
+    ACCOUNT_NUM*3/2/4, smallbank/ebpf/utils.h:16-17, and relies on chaining).
     """
     nb, s = table.key_hi.shape
     keys = np.asarray(keys, np.uint64)
@@ -161,18 +222,7 @@ def populate(table: KVTable, keys: np.ndarray, vals: np.ndarray,
     vals = np.asarray(vals, np.uint32)
     if vers is None:
         vers = np.ones(len(keys), np.uint32)
-    bkt = hashing.bucket_np(keys, nb)
-    order = np.argsort(bkt, kind="stable")
-    slot = np.zeros(len(keys), np.int64)
-    counts = np.zeros(nb, np.int64)
-    np.add.at(counts, bkt, 1)
-    if counts.max() > s:
-        raise ValueError(f"bucket overflow during populate: max {counts.max()} > {s} slots")
-    # slot = running index within bucket
-    sorted_bkt = bkt[order]
-    start = np.concatenate([[True], sorted_bkt[1:] != sorted_bkt[:-1]])
-    within = np.arange(len(keys)) - np.maximum.accumulate(np.where(start, np.arange(len(keys)), 0))
-    slot[order] = within
+    bkt, slot = assign_two_choice(keys, nb, s)
 
     k_hi, k_lo = u64.split(keys)
     key_hi = np.zeros((nb, s), np.uint32)

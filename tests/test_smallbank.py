@@ -1,0 +1,126 @@
+import jax
+import numpy as np
+
+from dint_tpu.clients import smallbank_client as sbc
+from dint_tpu.clients import workloads as wl
+from dint_tpu.engines import smallbank
+from dint_tpu.engines.types import Op, Reply, make_batch
+
+VW = 2
+
+
+def _batch(ops, tbls, accts, vals=None, vers=None, width=64):
+    return make_batch(ops, np.asarray(accts, np.uint64), vals, vers=vers,
+                      tables=np.asarray(tbls, np.int32), width=width, val_words=VW)
+
+
+def test_fused_lock_read_and_commit():
+    shard = smallbank.create(100, val_words=VW)
+    vals = np.zeros((100, VW), np.uint32)
+    vals[:, 0] = 50
+    vals[:, 1] = wl.SB_MAGIC
+    shard = shard.replace(
+        sav=shard.sav.replace(val=jax.numpy.asarray(vals),
+                              ver=jax.numpy.ones(100, jax.numpy.uint32)),
+        chk=shard.chk.replace(val=jax.numpy.asarray(vals),
+                              ver=jax.numpy.ones(100, jax.numpy.uint32)))
+    step = jax.jit(smallbank.step)
+
+    # X-lock + fused read; conflicting second X rejected; S on other table ok
+    b = _batch([Op.ACQ_X_READ, Op.ACQ_X_READ, Op.ACQ_S_READ],
+               [smallbank.CHECKING, smallbank.CHECKING, smallbank.SAVINGS],
+               [7, 7, 7])
+    shard, rep = step(shard, b)
+    rt = np.asarray(rep.rtype)
+    assert list(rt[:3]) == [Reply.GRANT, Reply.REJECT, Reply.GRANT]
+    assert np.asarray(rep.val)[0, 0] == 50
+    assert np.asarray(rep.val)[0, 1] == wl.SB_MAGIC
+    assert np.asarray(rep.ver)[0] == 1
+
+    # commit new value on checking(7), then release; next reader sees it
+    nv = np.zeros((1, VW), np.uint32)
+    nv[0, 0] = 123
+    nv[0, 1] = wl.SB_MAGIC
+    b = _batch([Op.COMMIT_PRIM], [smallbank.CHECKING], [7], nv,
+               vers=np.array([2], np.uint32))
+    shard, rep = step(shard, b)
+    assert np.asarray(rep.rtype)[0] == Reply.ACK
+    b = _batch([Op.REL_X], [smallbank.CHECKING], [7])
+    shard, rep = step(shard, b)
+    b = _batch([Op.ACQ_S_READ], [smallbank.CHECKING], [7])
+    shard, rep = step(shard, b)
+    assert np.asarray(rep.rtype)[0] == Reply.GRANT
+    assert np.asarray(rep.val)[0, 0] == 123
+    assert np.asarray(rep.ver)[0] == 2
+
+
+def test_commit_then_acquire_same_batch():
+    # commit installs before acquires read (batch serialization contract)
+    shard = smallbank.create(10, val_words=VW)
+    nv = np.zeros((2, VW), np.uint32)
+    nv[0, 0] = 9
+    b = _batch([Op.COMMIT_PRIM, Op.ACQ_S_READ],
+               [smallbank.SAVINGS, smallbank.SAVINGS], [3, 3], nv,
+               vers=np.array([5, 0], np.uint32))
+    shard, rep = step_once(shard, b)
+    assert np.asarray(rep.rtype)[1] == Reply.GRANT
+    assert np.asarray(rep.val)[1, 0] == 9
+    assert np.asarray(rep.ver)[1] == 5
+
+
+def step_once(shard, b):
+    return jax.jit(smallbank.step)(shard, b)
+
+
+def test_end_to_end_pipeline_and_invariants(rng):
+    n_accounts = 512
+    shards = sbc.init_shards(n_accounts, init_balance=1000)
+    coord = sbc.Coordinator(shards, width=1024)
+    base_total = sbc.total_balance(coord.shards)
+
+    # conserving mix only: amalgamate / balance / send_payment
+    mix = np.array([0.3, 0.2, 0.0, 0.5, 0.0, 0.0])
+    for _ in range(4):
+        ttype, a1, a2 = wl.sb_make_txns(rng, 256, n_accounts, mix=mix)
+        coord.run_cohort(ttype, a1, a2)
+
+    st = coord.stats
+    assert st.attempted == 4 * 256
+    assert st.committed > 0
+    assert st.committed + st.aborted_lock + st.aborted_logic >= st.attempted * 0.99
+
+    # invariant 1: money conserved (conserving mix)
+    assert sbc.total_balance(coord.shards) == base_total
+
+    # invariant 2: all locks released at the end
+    for s in coord.shards:
+        assert int(np.asarray(s.sav_sh).sum()) == 0
+        assert int(np.asarray(s.sav_ex).sum()) == 0
+        assert int(np.asarray(s.chk_sh).sum()) == 0
+        assert int(np.asarray(s.chk_ex).sum()) == 0
+
+    # invariant 3: replicas converged (every commit reached all 3)
+    for tbl in ("sav", "chk"):
+        v0 = np.asarray(getattr(coord.shards[0], tbl).val)
+        r0 = np.asarray(getattr(coord.shards[0], tbl).ver)
+        for s in coord.shards[1:]:
+            assert np.array_equal(v0, np.asarray(getattr(s, tbl).val))
+            assert np.array_equal(r0, np.asarray(getattr(s, tbl).ver))
+
+    # invariant 4: log got one entry per written key per shard
+    heads = [int(np.asarray(s.log.head).sum()) for s in coord.shards]
+    assert heads[0] == heads[1] == heads[2]
+    assert heads[0] > 0
+
+
+def test_full_mix_runs(rng):
+    n_accounts = 256
+    shards = sbc.init_shards(n_accounts)
+    coord = sbc.Coordinator(shards, width=1024)
+    for _ in range(3):
+        ttype, a1, a2 = wl.sb_make_txns(rng, 200, n_accounts)
+        coord.run_cohort(ttype, a1, a2)
+    assert coord.stats.committed > 0
+    # versions monotone: ver >= 1 everywhere, and bounded by 1 + commits
+    for s in coord.shards:
+        assert (np.asarray(s.sav.ver) >= 1).all()

@@ -152,10 +152,41 @@ def test_bloom_exact_after_churn(rng):
     table, _ = run_step(table, [Op.DELETE] * 100, keys[:100], rand_vals(rng, 100),
                         bloom=True)
     # bloom must still admit all live keys (no false negatives)
-    from dint_tpu.ops import u64
-    hi, lo = map(np.asarray, u64.split(keys[100:]))
     import jax.numpy as jnp
-    from dint_tpu.ops import hashing
-    bkt = hashing.bucket(jnp.asarray(hi), jnp.asarray(lo), table.n_buckets)
-    ok = np.asarray(kv.bloom_maybe(table, jnp.asarray(hi), jnp.asarray(lo), bkt))
+    from dint_tpu.ops import hashing, u64
+    hi, lo = map(jnp.asarray, u64.split(keys[100:]))
+    b1, b2 = hashing.bucket_pair(hi, lo, table.n_buckets)
+    ok = np.asarray(kv.bloom_maybe(table, hi, lo, b1, b2))
     assert ok.all()
+
+
+def test_two_choice_capacity(rng):
+    # load factor 0.76 with 4-slot buckets: impossible for single-choice
+    # hashing (Poisson tail), fine for two-choice placement
+    table = kv.create(1 << 16, slots=4, val_words=VW)
+    keys = rng.choice(1 << 40, size=200_000, replace=False).astype(np.uint64)
+    table = kv.populate(table, keys, np.zeros((len(keys), VW), np.uint32))
+    d = kv.to_dict(table)
+    assert len(d) == len(keys)
+
+
+def test_insert_falls_back_to_alternate_bucket(rng):
+    # craft keys sharing the same preferred bucket in a 2-bucket, 1-slot
+    # table: the loser of the preferred bucket must land in its alternate,
+    # not SPILL (two-choice insert fallback)
+    from dint_tpu.ops import hashing
+    ks = np.arange(1, 4000, dtype=np.uint64)
+    b1, b2 = hashing.bucket_pair_np(ks, 2)
+    cands = ks[(b1 == 0) & (b2 == 1)]
+    assert len(cands) >= 2
+    k1, k2 = cands[:2]
+    table = kv.create(2, slots=1, val_words=VW)
+    table, (rt, _, _) = run_step(table, [Op.INSERT, Op.INSERT],
+                                 np.array([k1, k2], np.uint64), rand_vals(rng, 2))
+    assert list(rt) == [Reply.ACK, Reply.ACK]
+    assert set(kv.to_dict(table)) == {int(k1), int(k2)}
+    # a third key with the same candidates now genuinely has nowhere to go
+    k3 = cands[2]
+    table, (rt, _, _) = run_step(table, [Op.INSERT],
+                                 np.array([k3], np.uint64), rand_vals(rng, 1))
+    assert list(rt) == [Reply.SPILL]

@@ -1,0 +1,107 @@
+import jax
+import numpy as np
+
+from dint_tpu.clients import tatp_client as tc
+from dint_tpu.engines import tatp
+from dint_tpu.engines.types import Op, Reply, make_batch
+
+VW = 4
+P = 200  # subscribers
+
+
+def _shards(rng):
+    return tc.populate_shards(rng, P, val_words=VW,
+                              cf_buckets=1 << 10, cf_lock_slots=1 << 10)
+
+
+def _b(ops, tbls, keys, vals=None, vers=None, width=64):
+    return make_batch(ops, np.asarray(keys, np.uint64), vals, vers=vers,
+                      tables=np.asarray(tbls, np.int32), width=width, val_words=VW)
+
+
+def test_dense_occ_read_lock_commit(rng):
+    shards, _ = _shards(rng)
+    s = shards[0]
+    step = jax.jit(tatp.step)
+    # read sub 5, lock it, second lock rejected
+    b = _b([Op.OCC_READ, Op.OCC_LOCK, Op.OCC_LOCK],
+           [tatp.SUBSCRIBER] * 3, [5, 5, 5])
+    s, rep = step(s, b)
+    rt = np.asarray(rep.rtype)
+    assert list(rt[:3]) == [Reply.VAL, Reply.GRANT, Reply.REJECT]
+    v1 = np.asarray(rep.ver)[0]
+    # commit installs + unlocks; re-read sees new val, ver+1; lock regrantable
+    nv = np.zeros((1, VW), np.uint32)
+    nv[0, 0] = 777
+    nv[0, 1] = tc.MAGIC
+    s, rep = step(s, _b([Op.COMMIT_PRIM], [tatp.SUBSCRIBER], [5], nv))
+    s, rep = step(s, _b([Op.OCC_READ, Op.OCC_LOCK], [tatp.SUBSCRIBER] * 2, [5, 5]))
+    assert np.asarray(rep.rtype)[0] == Reply.VAL
+    assert np.asarray(rep.val)[0, 0] == 777
+    assert np.asarray(rep.ver)[0] == v1 + 1
+    assert np.asarray(rep.rtype)[1] == Reply.GRANT
+
+
+def test_cf_insert_delete_cycle(rng):
+    shards, cf_keys = _shards(rng)
+    s = shards[0]
+    step = jax.jit(tatp.step)
+    # pick a cf key that does NOT exist
+    k = 0
+    while k in set(int(x) for x in cf_keys):
+        k += 1
+    b = _b([Op.OCC_READ], [tatp.CALL_FORWARDING], [k])
+    s, rep = step(s, b)
+    assert np.asarray(rep.rtype)[0] == Reply.NOT_EXIST
+    # lock + insert prim
+    s, rep = step(s, _b([Op.OCC_LOCK], [tatp.CALL_FORWARDING], [k]))
+    assert np.asarray(rep.rtype)[0] == Reply.GRANT
+    nv = np.zeros((1, VW), np.uint32)
+    nv[0, 0] = 42
+    nv[0, 1] = tc.MAGIC
+    s, rep = step(s, _b([Op.INSERT_PRIM], [tatp.CALL_FORWARDING], [k], nv))
+    assert np.asarray(rep.rtype)[0] == Reply.ACK
+    # lock released by INSERT_PRIM; read finds it
+    s, rep = step(s, _b([Op.OCC_LOCK, Op.OCC_READ],
+                        [tatp.CALL_FORWARDING] * 2, [k, k]))
+    assert np.asarray(rep.rtype)[0] == Reply.GRANT
+    assert np.asarray(rep.rtype)[1] == Reply.VAL
+    assert np.asarray(rep.val)[1, 0] == 42
+    # delete + verify gone
+    s, rep = step(s, _b([Op.DELETE_PRIM], [tatp.CALL_FORWARDING], [k]))
+    assert np.asarray(rep.rtype)[0] == Reply.ACK
+    s, rep = step(s, _b([Op.OCC_READ], [tatp.CALL_FORWARDING], [k]))
+    assert np.asarray(rep.rtype)[0] == Reply.NOT_EXIST
+
+
+def test_end_to_end_cohorts(rng):
+    shards, _ = _shards(rng)
+    coord = tc.Coordinator(shards, P, width=2048, val_words=VW)
+    for _ in range(4):
+        coord.run_cohort(rng, 256)
+    st = coord.stats
+    assert st.attempted == 4 * 256
+    assert st.committed > st.attempted * 0.5
+    accounted = st.committed + st.aborted_lock + st.aborted_validate + st.aborted_missing
+    assert accounted == st.attempted
+
+    # all locks free at the end
+    for s in coord.shards:
+        assert not np.asarray(s.sub_lock).any()
+        assert not np.asarray(s.sf_lock).any()
+        assert not np.asarray(s.cf_lock.locked).any()
+
+    # replicas converged on every table
+    s0 = coord.shards[0]
+    for s in coord.shards[1:]:
+        for tb in ("sub", "sec", "ai", "sf"):
+            assert np.array_equal(np.asarray(getattr(s0, tb).val),
+                                  np.asarray(getattr(s, tb).val))
+            assert np.array_equal(np.asarray(getattr(s0, tb).ver),
+                                  np.asarray(getattr(s, tb).ver))
+        from dint_tpu.tables import kv as kvt
+        assert kvt.to_dict(s0.cf) == kvt.to_dict(s.cf)
+
+    # log heads advanced identically on all shards
+    heads = [int(np.asarray(s.log.head).sum()) for s in coord.shards]
+    assert heads[0] == heads[1] == heads[2]
