@@ -22,6 +22,7 @@ import dataclasses
 import jax
 import numpy as np
 
+from .. import stats
 from ..engines import smallbank
 from ..engines.types import Batch, Op, Reply, make_batch
 from . import workloads as wl
@@ -31,15 +32,9 @@ N_SHARDS = 3
 
 
 @dataclasses.dataclass
-class Stats:
-    attempted: int = 0
-    committed: int = 0
+class Stats(stats.TxnStats):
     aborted_lock: int = 0
     aborted_logic: int = 0   # insufficient funds etc.
-
-    @property
-    def abort_rate(self):
-        return 1.0 - self.committed / max(self.attempted, 1)
 
 
 def init_shards(n_accounts: int, init_balance: int = 1000):

@@ -25,6 +25,7 @@ import dataclasses
 import jax
 import numpy as np
 
+from .. import stats
 from ..engines import tatp
 from ..engines.types import Op, Reply, make_batch
 from ..tables import kv
@@ -35,16 +36,10 @@ MAGIC = 0x7A79
 
 
 @dataclasses.dataclass
-class Stats:
-    attempted: int = 0
-    committed: int = 0
+class Stats(stats.TxnStats):
     aborted_lock: int = 0      # write-set lock rejected
     aborted_validate: int = 0  # read-set version changed
     aborted_missing: int = 0   # required row absent / insert-exists
-
-    @property
-    def abort_rate(self):
-        return 1.0 - self.committed / max(self.attempted, 1)
 
 
 def populate_shards(rng: np.random.Generator, n_subscribers: int,

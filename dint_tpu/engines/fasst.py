@@ -50,6 +50,11 @@ def step(table: locks.OCCTable, batch: Batch):
     rtype = jnp.where(is_read, Reply.VAL, rtype)
     rtype = jnp.where(is_lock, jnp.where(grant, Reply.GRANT, Reply.REJECT), rtype)
     rver = jnp.where(is_read, ver1, U32(0))
+    # READ_VER also reports the lock bit (reply val word 0), as the
+    # reference's validation re-read does — a locked slot fails OCC
+    # validation (lock_fasst/caladan/client.cc:199-215). `locked1` is the
+    # state after this batch's unlocks, before its acquires (oracle order).
+    rlocked = jnp.where(is_read, locked1.astype(U32), U32(0))
 
     touched = op != Op.NOP
     writer = sb.last & segments.seg_any(sb, touched)
@@ -57,6 +62,6 @@ def step(table: locks.OCCTable, batch: Batch):
         locked=segments.scatter_rows(table.locked, s_slot, new_locked, writer),
         ver=segments.scatter_rows(table.ver, s_slot, ver1, writer),
     )
-    o_rtype, o_rver = segments.unsort(sb, rtype, rver)
-    zeros = jnp.zeros((r, batch.val.shape[1]), U32)
-    return table, Replies(rtype=o_rtype, val=zeros, ver=o_rver)
+    o_rtype, o_rver, o_rlocked = segments.unsort(sb, rtype, rver, rlocked)
+    rval = jnp.zeros((r, batch.val.shape[1]), U32).at[:, 0].set(o_rlocked)
+    return table, Replies(rtype=o_rtype, val=rval, ver=o_rver)

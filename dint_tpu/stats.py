@@ -1,0 +1,213 @@
+"""L5 measurement layer: the reference's client-side stat contract.
+
+Re-expresses the reference's per-thread counters + timed stat window
+(/root/reference/store/caladan/stat.h:10-20: warmup to t=5s, measure to
+t=15s) and the final metric block every client prints (throughput, goodput,
+average/median/99th/99.9th latency in microseconds —
+tatp/caladan/client_ebpf_shard.cc:368-377). Batched TPU execution changes
+*how* latencies arise (a txn's latency spans the waves of its cohort) but
+not the metric definitions, which are kept identical so results are
+side-by-side comparable with the reference's clients.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class Window:
+    """Warmup/measure/exit schedule (store/caladan/stat.h:10-13)."""
+    warmup_s: float = 5.0
+    measure_s: float = 10.0
+
+    @property
+    def total_s(self):
+        return self.warmup_s + self.measure_s
+
+
+class StatClock:
+    """Drives a client loop through warmup -> measure -> done phases.
+
+    Usage: tick() each iteration; record counters only when `measuring`
+    (False again once the window has ended).
+    """
+
+    def __init__(self, window: Window | None = None):
+        self.window = window or Window()
+        self.t0 = time.monotonic()
+        self._measure_t0 = None
+        self._measure_t1 = None
+        self._done = False
+
+    def tick(self) -> str:
+        t = time.monotonic() - self.t0
+        if t < self.window.warmup_s:
+            return "warmup"
+        if t < self.window.total_s:
+            if self._measure_t0 is None:
+                self._measure_t0 = time.monotonic()
+            self._measure_t1 = time.monotonic()
+            return "measure"
+        self._done = True
+        return "done"
+
+    @property
+    def measuring(self) -> bool:
+        return (not self._done and self._measure_t0 is not None
+                and self._measure_t1 is not None)
+
+    @property
+    def measured_s(self) -> float:
+        if self._measure_t0 is None or self._measure_t1 is None:
+            return 0.0
+        return self._measure_t1 - self._measure_t0
+
+
+class LatencyReservoir:
+    """Latency sample store (µs). The reference keeps every sample in a
+    per-thread vector and nth_element's it (store/caladan/stat.h:15-20);
+    we keep up to `cap` samples with reservoir downsampling past that."""
+
+    def __init__(self, cap: int = 1 << 20, seed: int = 0):
+        self.cap = cap
+        self.samples = np.empty(cap, np.float64)
+        self.n_kept = 0
+        self.n_seen = 0
+        self._rng = np.random.default_rng(seed)
+
+    def add(self, lat_us: np.ndarray | float):
+        arr = np.atleast_1d(np.asarray(lat_us, np.float64))
+        for start in range(0, len(arr), self.cap):
+            self._add_chunk(arr[start:start + self.cap])
+
+    def _add_chunk(self, arr):
+        n = len(arr)
+        room = self.cap - self.n_kept
+        take = min(room, n)
+        if take:
+            self.samples[self.n_kept:self.n_kept + take] = arr[:take]
+            self.n_kept += take
+        rest = arr[take:]
+        if len(rest):
+            # reservoir: each later sample replaces a random kept one with
+            # probability cap / seen-so-far
+            seen = self.n_seen + take + np.arange(1, len(rest) + 1)
+            keep = self._rng.random(len(rest)) < (self.cap / seen)
+            idx = self._rng.integers(0, self.cap, size=len(rest))
+            self.samples[idx[keep]] = rest[keep]
+        self.n_seen += n
+
+    def percentiles(self):
+        if self.n_kept == 0:
+            return dict(avg=0.0, p50=0.0, p99=0.0, p999=0.0)
+        s = self.samples[: self.n_kept]
+        p50, p99, p999 = np.percentile(s, [50, 99, 99.9])
+        return dict(avg=float(s.mean()), p50=float(p50), p99=float(p99),
+                    p999=float(p999))
+
+
+@dataclasses.dataclass
+class TxnStats:
+    """Base attempted/committed accounting shared by all txn coordinators
+    (client Stats dataclasses subclass this with their abort breakdowns)."""
+    attempted: int = 0
+    committed: int = 0
+
+    @property
+    def abort_rate(self):
+        if self.attempted == 0:
+            return 0.0
+        return 1.0 - self.committed / self.attempted
+
+
+@dataclasses.dataclass
+class MetricBlock:
+    """The fixed stat block (client_ebpf_shard.cc:368-377), plus the TPU
+    device-duty-cycle analogue of `primary ucores/kcores`."""
+    throughput: float        # attempted txn/s (pkt/s for microbenchmarks)
+    goodput: float           # committed txn/s
+    avg_us: float
+    p50_us: float
+    p99_us: float
+    p999_us: float
+    device_duty: float = 0.0   # fraction of wall time the device was stepping
+    extra: dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def abort_rate(self):
+        if self.throughput <= 0:
+            return 0.0
+        return 1.0 - self.goodput / self.throughput
+
+    def to_dict(self):
+        d = dict(throughput=round(self.throughput, 1),
+                 goodput=round(self.goodput, 1),
+                 abort_rate=round(self.abort_rate, 6),
+                 avg_us=round(self.avg_us, 2), p50_us=round(self.p50_us, 2),
+                 p99_us=round(self.p99_us, 2), p999_us=round(self.p999_us, 2),
+                 device_duty=round(self.device_duty, 4))
+        d.update(self.extra)
+        return d
+
+    def format(self) -> str:
+        """Human block in the reference's shape (client_ebpf_shard.cc:368-377)."""
+        lines = [
+            f"throughput: {self.throughput:.1f}",
+            f"goodput: {self.goodput:.1f}",
+            f"average: {self.avg_us:.2f} us",
+            f"median: {self.p50_us:.2f} us",
+            f"99th: {self.p99_us:.2f} us",
+            f"99.9th: {self.p999_us:.2f} us",
+            f"device duty: {self.device_duty:.4f}",
+        ]
+        for k, v in self.extra.items():
+            lines.append(f"{k}: {v}")
+        return "\n".join(lines)
+
+    def json(self) -> str:
+        return json.dumps(self.to_dict())
+
+
+class Recorder:
+    """Counter + latency accumulator a client drives during the measure
+    window; emits the MetricBlock at the end.
+
+    Call :meth:`reset` after warmup so jit compile time and cold-cache waves
+    don't pollute the measured window (the reference's stat window likewise
+    excludes the first 5 s, store/caladan/stat.h:10-13)."""
+
+    def __init__(self, lat_cap: int = 1 << 20):
+        self._lat_cap = lat_cap
+        self.extra: dict = {}
+        self.reset()
+
+    def reset(self):
+        self.attempted = 0
+        self.committed = 0
+        self.lat = LatencyReservoir(self._lat_cap)
+        self.device_busy_s = 0.0
+
+    def record(self, attempted: int, committed: int,
+               lat_us: np.ndarray | None = None,
+               device_s: float = 0.0):
+        self.attempted += attempted
+        self.committed += committed
+        if lat_us is not None and len(np.atleast_1d(lat_us)):
+            self.lat.add(lat_us)
+        self.device_busy_s += device_s
+
+    def block(self, elapsed_s: float) -> MetricBlock:
+        p = self.lat.percentiles()
+        el = max(elapsed_s, 1e-12)
+        return MetricBlock(
+            throughput=self.attempted / el,
+            goodput=self.committed / el,
+            avg_us=p["avg"], p50_us=p["p50"], p99_us=p["p99"],
+            p999_us=p["p999"],
+            device_duty=self.device_busy_s / el,
+            extra=dict(self.extra),
+        )

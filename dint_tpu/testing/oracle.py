@@ -118,6 +118,7 @@ class OCCOracle:
         r = len(ops)
         rtype = np.zeros(r, np.int32)
         rver = np.zeros(r, np.uint32)
+        rlocked = np.zeros(r, np.uint32)
         for i in range(r):  # commits/aborts first
             s = int(slots[i])
             if ops[i] == Op.COMMIT_VER:
@@ -127,11 +128,12 @@ class OCCOracle:
             elif ops[i] == Op.ABORT:
                 self.locked[s] = False
                 rtype[i] = Reply.ACK
-        for i in range(r):  # reads see post-commit versions
+        for i in range(r):  # reads see post-commit versions + lock bits
             if ops[i] == Op.READ_VER:
                 s = int(slots[i])
                 rtype[i] = Reply.VAL
                 rver[i] = self.ver[s]
+                rlocked[i] = np.uint32(self.locked[s])
         for i in range(r):  # lock acquires in lane order
             if ops[i] == Op.LOCK:
                 s = int(slots[i])
@@ -140,4 +142,4 @@ class OCCOracle:
                     rtype[i] = Reply.GRANT
                 else:
                     rtype[i] = Reply.REJECT
-        return rtype, rver
+        return rtype, rver, rlocked

@@ -110,9 +110,10 @@ def test_fasst_differential(rng):
         table, rep = step(table, b)
         rt = np.asarray(rep.rtype)
         rv = np.asarray(rep.ver)
-        ot, over = oracle.step(ops, slots)
+        ot, over, olocked = oracle.step(ops, slots)
         assert np.array_equal(rt, ot)
         assert np.array_equal(rv, over)
+        assert np.array_equal(np.asarray(rep.val)[:, 0], olocked)
         for i in range(n):
             if rt[i] == Reply.GRANT:
                 held.append(int(slots[i]))
