@@ -1,0 +1,9 @@
+"""Native host shim: C++ UDP request pump + wire-format profiles + pump."""
+from .native import (FMT_FASST9, FMT_LOCK6, FMT_LOG53, FMT_MSG55, VAL_SIZE,
+                     ShimClient, ShimServer)
+from .pump import EnginePump
+from .wire import FASST, LOCK2PL, LOG, PROFILES, SMALLBANK, STORE, TATP
+
+__all__ = ["ShimClient", "ShimServer", "EnginePump", "PROFILES", "STORE",
+           "LOCK2PL", "FASST", "LOG", "SMALLBANK", "TATP", "VAL_SIZE",
+           "FMT_MSG55", "FMT_LOCK6", "FMT_FASST9", "FMT_LOG53"]

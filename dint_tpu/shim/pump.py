@@ -1,0 +1,92 @@
+"""EnginePump: serve a batch-certification engine over the native UDP shim.
+
+This is the L0/L4 glue of SURVEY.md §2.4's "TPU equivalent" row: the C++
+pump (native/shim.cc) accumulates reference-wire-format datagrams into a
+fixed-width batch, this class translates wire codes -> engine ops
+(shim.wire profiles), pads to the jitted step's static width, runs the
+step, translates Reply codes back, and hands the reply arrays to C++ for
+sendmmsg scatter. One thread; the jitted step overlaps with C++ RX
+batching naturally (the RX thread fills the next ring slot while the
+device runs).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import numpy as np
+
+from ..engines.types import make_batch
+from .native import VAL_SIZE, ShimServer
+from .wire import Profile
+
+
+class EnginePump:
+    """Owns engine state; serves batches arriving on a ShimServer."""
+
+    def __init__(self, profile: Profile, step_fn, state, width: int = 4096,
+                 port: int = 0, flush_us: int = 200, val_words: int = 10):
+        self.profile = profile
+        self.state = state
+        self.width = width
+        self.val_words = val_words
+        self._step = jax.jit(step_fn, donate_argnums=0)
+        self.server = ShimServer(port=port, width=width, flush_us=flush_us,
+                                 fmt=profile.fmt)
+        self.port = self.server.port
+        self.batches_served = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def serve_one(self, timeout_us: int = 100_000) -> bool:
+        """Poll one batch, certify, reply. Returns True if a batch ran."""
+        got = self.server.poll(timeout_us)
+        if got is None:
+            return False
+        slot, b = got
+        n = len(b["key"])
+        wire_type = b["type"].copy()  # views die at reply(); copy what we keep
+        ops = self.profile.to_ops(wire_type, b["table"])
+        vals = np.ascontiguousarray(b["val"]).view(np.uint32)
+        vals = vals[:, :self.val_words]
+        batch = make_batch(ops, b["key"], vals=vals, vers=b["ver"],
+                           tables=b["table"].astype(np.int32),
+                           width=self.width, val_words=self.val_words)
+        self.state, replies = self._step(self.state, batch)
+        rtype = np.asarray(replies.rtype)[:n]
+        rval32 = np.asarray(replies.val)[:n]
+        rver = np.asarray(replies.ver)[:n]
+        wire_reply = self.profile.to_wire(wire_type, rtype)
+        rval = np.zeros((n, VAL_SIZE), np.uint8)
+        rval[:, :self.val_words * 4] = np.ascontiguousarray(
+            rval32[:, :self.val_words]).view(np.uint8).reshape(n, -1)
+        self.server.reply(slot, wire_reply, rval, rver)
+        self.batches_served += 1
+        return True
+
+    def serve_forever(self):
+        while not self._stop.is_set():
+            self.serve_one(timeout_us=50_000)
+
+    def start(self):
+        """Run the serve loop on a background thread (tests/benchmarks)."""
+        self._thread = threading.Thread(target=self.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join()
+            self._thread = None
+
+    def close(self):
+        self.stop()
+        self.server.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()

@@ -44,13 +44,19 @@ class StatClock:
         self._done = False
 
     def tick(self) -> str:
-        t = time.monotonic() - self.t0
+        now = time.monotonic()
+        t = now - self.t0
+        # Close the interval over the wave that ran since the previous tick
+        # BEFORE classifying this one, so the final measured wave's duration
+        # is included when this tick crosses into "done" (counts and time
+        # then cover exactly the same waves).
+        if self._measure_t0 is not None and not self._done:
+            self._measure_t1 = now
         if t < self.window.warmup_s:
             return "warmup"
         if t < self.window.total_s:
             if self._measure_t0 is None:
-                self._measure_t0 = time.monotonic()
-            self._measure_t1 = time.monotonic()
+                self._measure_t0 = self._measure_t1 = now
             return "measure"
         self._done = True
         return "done"

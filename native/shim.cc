@@ -1,0 +1,508 @@
+// dint_tpu native host shim: the L0 packet-I/O layer of the framework.
+//
+// Re-expresses the reference's per-packet server loops (kernel-UDP worker
+// sockets, /root/reference/store/udp/server.cc:50-98; XDP rx + in-place
+// reply rewrite, store/ebpf/utils.h:81-102) as a *batching* pump: a RX
+// thread drains the socket with recvmmsg into fixed-width struct-of-arrays
+// batch buffers sized for one TPU engine step, the Python side polls a
+// ready-ring, runs the jitted batch-certification step, and hands back
+// reply codes; this file serializes replies (request packet mutated in
+// place, exactly the reference's reply convention) and scatters them with
+// sendmmsg.
+//
+// Wire formats (bit-compatible with the reference so its clients could be
+// pointed at this server):
+//   MSG55   {ord u8, type u8, table u8, key u64, val[40], ver u32}
+//           store/smallbank/tatp (tatp/ebpf/utils.h:80-87)
+//   LOCK6   {action u8, lid u32, type u8}          (lock_2pl/ebpf/utils.h:38-42)
+//   FASST9  {type u8, lid u32, ver u32}            (lock_fasst/caladan/proto.h:32-36)
+//   LOG53   {type u8, key u64, val[40], ver u32}   (log_server/ebpf/utils.h:26-31)
+//
+// Build: make -C native   (g++ -O2 -shared; no deps beyond pthreads)
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kValSize = 40;  // VAL_SIZE, store/ebpf/utils.h:11
+
+enum Format : int { MSG55 = 0, LOCK6 = 1, FASST9 = 2, LOG53 = 3 };
+
+#pragma pack(push, 1)
+struct WireMsg55 {
+  uint8_t ord, type, table;
+  uint64_t key;
+  uint8_t val[kValSize];
+  uint32_t ver;
+};
+struct WireLock6 {
+  uint8_t action;
+  uint32_t lid;
+  uint8_t type;
+};
+struct WireFasst9 {
+  uint8_t type;
+  uint32_t lid;
+  uint32_t ver;
+};
+struct WireLog53 {
+  uint8_t type;
+  uint64_t key;
+  uint8_t val[kValSize];
+  uint32_t ver;
+};
+#pragma pack(pop)
+
+static_assert(sizeof(WireMsg55) == 55, "wire layout");
+static_assert(sizeof(WireLock6) == 6, "wire layout");
+static_assert(sizeof(WireFasst9) == 9, "wire layout");
+static_assert(sizeof(WireLog53) == 53, "wire layout");
+
+size_t wire_size(int fmt) {
+  switch (fmt) {
+    case LOCK6: return sizeof(WireLock6);
+    case FASST9: return sizeof(WireFasst9);
+    case LOG53: return sizeof(WireLog53);
+    default: return sizeof(WireMsg55);
+  }
+}
+
+// One fixed-width SoA batch: the host-side mirror of engines.types.Batch.
+struct BatchBuf {
+  uint32_t count = 0;
+  std::vector<uint8_t> ord, type, table;
+  std::vector<uint64_t> key;
+  std::vector<uint8_t> val;  // [width * kValSize]
+  std::vector<uint32_t> ver;
+  std::vector<sockaddr_in> src;
+
+  explicit BatchBuf(uint32_t width)
+      : ord(width), type(width), table(width), key(width),
+        val(size_t(width) * kValSize), ver(width), src(width) {}
+};
+
+struct View {
+  uint32_t count, slot;
+  uint8_t *ord, *type, *table;
+  uint64_t *key;
+  uint8_t *val;
+  uint32_t *ver;
+};
+
+class Server {
+ public:
+  Server(const char* ip, uint16_t port, uint32_t width, uint32_t flush_us,
+         uint32_t nrings, int fmt)
+      : width_(width), flush_us_(flush_us), fmt_(fmt) {
+    fd_ = socket(AF_INET, SOCK_DGRAM, 0);
+    int one = 1;
+    setsockopt(fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    int buf = 1 << 26;  // SOCKET_BUF_SIZE, store/ebpf/utils.h:34
+    setsockopt(fd_, SOL_SOCKET, SO_RCVBUF, &buf, sizeof(buf));
+    setsockopt(fd_, SOL_SOCKET, SO_SNDBUF, &buf, sizeof(buf));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(port);
+    addr.sin_addr.s_addr = ip && *ip ? inet_addr(ip) : INADDR_ANY;
+    bound_ok_ = bind(fd_, (sockaddr*)&addr, sizeof(addr)) == 0;
+    socklen_t alen = sizeof(addr);
+    getsockname(fd_, (sockaddr*)&addr, &alen);
+    port_ = ntohs(addr.sin_port);
+    timeval tv{0, 2000};  // 2ms rx poll so flush timeouts are honored
+    setsockopt(fd_, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+
+    for (uint32_t i = 0; i < nrings; i++) {
+      bufs_.emplace_back(width);
+      free_.push_back(i);
+    }
+    rx_ = std::thread([this] { RxLoop(); });
+  }
+
+  ~Server() {
+    stop_.store(true);
+    if (rx_.joinable()) rx_.join();
+    close(fd_);
+  }
+
+  uint16_t port() const { return port_; }
+  bool ok() const { return bound_ok_; }
+
+  int Poll(uint32_t timeout_us, View* out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    if (!cv_.wait_for(lk, std::chrono::microseconds(timeout_us),
+                      [this] { return !ready_.empty(); }))
+      return 0;
+    uint32_t slot = ready_.front();
+    ready_.pop_front();
+    BatchBuf& b = bufs_[slot];
+    *out = View{b.count, slot, b.ord.data(), b.type.data(), b.table.data(),
+                b.key.data(), b.val.data(), b.ver.data()};
+    return 1;
+  }
+
+  // Reply convention = reference's in-place packet rewrite: echo
+  // ord/table/key from the request, overwrite type/val/ver.
+  int Reply(uint32_t slot, const uint8_t* rtype, const uint8_t* rval,
+            const uint32_t* rver) {
+    BatchBuf& b = bufs_[slot];
+    uint32_t n = b.count;
+    size_t wsz = wire_size(fmt_);
+    std::vector<uint8_t> wire(size_t(n) * wsz);
+    std::vector<mmsghdr> hdrs(n);
+    std::vector<iovec> iovs(n);
+    for (uint32_t i = 0; i < n; i++) {
+      uint8_t* w = wire.data() + size_t(i) * wsz;
+      switch (fmt_) {
+        case LOCK6: {
+          auto* m = (WireLock6*)w;
+          m->action = rtype[i];
+          m->lid = (uint32_t)b.key[i];
+          m->type = b.table[i];  // echo the S/X lock type byte
+          break;
+        }
+        case FASST9: {
+          auto* m = (WireFasst9*)w;
+          m->type = rtype[i];
+          m->lid = (uint32_t)b.key[i];
+          m->ver = rver ? rver[i] : 0;
+          break;
+        }
+        case LOG53: {
+          auto* m = (WireLog53*)w;
+          m->type = rtype[i];
+          m->key = b.key[i];
+          if (rval) memcpy(m->val, rval + size_t(i) * kValSize, kValSize);
+          m->ver = rver ? rver[i] : 0;
+          break;
+        }
+        default: {
+          auto* m = (WireMsg55*)w;
+          m->ord = b.ord[i];
+          m->type = rtype[i];
+          m->table = b.table[i];
+          m->key = b.key[i];
+          if (rval)
+            memcpy(m->val, rval + size_t(i) * kValSize, kValSize);
+          else
+            memset(m->val, 0, kValSize);
+          m->ver = rver ? rver[i] : 0;
+        }
+      }
+      iovs[i] = {w, wsz};
+      hdrs[i] = mmsghdr{};
+      hdrs[i].msg_hdr.msg_name = &b.src[i];
+      hdrs[i].msg_hdr.msg_namelen = sizeof(sockaddr_in);
+      hdrs[i].msg_hdr.msg_iov = &iovs[i];
+      hdrs[i].msg_hdr.msg_iovlen = 1;
+    }
+    uint32_t sent = 0;
+    while (sent < n) {
+      int r = sendmmsg(fd_, hdrs.data() + sent, n - sent, 0);
+      if (r <= 0) break;
+      sent += r;
+    }
+    pkts_tx_.fetch_add(sent);
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      b.count = 0;
+      free_.push_back(slot);
+    }
+    return (int)sent;
+  }
+
+  void Stats(uint64_t out[4]) {
+    out[0] = pkts_rx_.load();
+    out[1] = pkts_tx_.load();
+    out[2] = batches_.load();
+    out[3] = dropped_.load();
+  }
+
+ private:
+  void RxLoop() {
+    const size_t wsz = wire_size(fmt_);
+    const uint32_t burst = std::min<uint32_t>(width_, 256);
+    std::vector<uint8_t> wire(size_t(burst) * wsz);
+    std::vector<mmsghdr> hdrs(burst);
+    std::vector<iovec> iovs(burst);
+    std::vector<sockaddr_in> srcs(burst);
+    int cur = -1;  // slot being filled
+    auto first_pkt_t = std::chrono::steady_clock::now();
+
+    while (!stop_.load()) {
+      for (uint32_t i = 0; i < burst; i++) {
+        iovs[i] = {wire.data() + size_t(i) * wsz, wsz};
+        hdrs[i] = mmsghdr{};
+        hdrs[i].msg_hdr.msg_name = &srcs[i];
+        hdrs[i].msg_hdr.msg_namelen = sizeof(sockaddr_in);
+        hdrs[i].msg_hdr.msg_iov = &iovs[i];
+        hdrs[i].msg_hdr.msg_iovlen = 1;
+      }
+      int got = recvmmsg(fd_, hdrs.data(), burst, 0, nullptr);
+      auto now = std::chrono::steady_clock::now();
+      if (got > 0) {
+        pkts_rx_.fetch_add(got);
+        for (int i = 0; i < got; i++) {
+          if (hdrs[i].msg_len < wsz) continue;  // runt
+          if (cur < 0) {
+            cur = TakeFree();
+            if (cur < 0) { dropped_.fetch_add(got - i); break; }
+            first_pkt_t = now;
+          }
+          BatchBuf& b = bufs_[cur];
+          uint32_t j = b.count++;
+          const uint8_t* w = wire.data() + size_t(i) * wsz;
+          switch (fmt_) {
+            case LOCK6: {
+              auto* m = (const WireLock6*)w;
+              b.ord[j] = 0; b.type[j] = m->action; b.table[j] = m->type;
+              b.key[j] = m->lid; b.ver[j] = 0;
+              memset(&b.val[size_t(j) * kValSize], 0, kValSize);
+              break;
+            }
+            case FASST9: {
+              auto* m = (const WireFasst9*)w;
+              b.ord[j] = 0; b.type[j] = m->type; b.table[j] = 0;
+              b.key[j] = m->lid; b.ver[j] = m->ver;
+              memset(&b.val[size_t(j) * kValSize], 0, kValSize);
+              break;
+            }
+            case LOG53: {
+              auto* m = (const WireLog53*)w;
+              b.ord[j] = 0; b.type[j] = m->type; b.table[j] = 0;
+              b.key[j] = m->key; b.ver[j] = m->ver;
+              memcpy(&b.val[size_t(j) * kValSize], m->val, kValSize);
+              break;
+            }
+            default: {
+              auto* m = (const WireMsg55*)w;
+              b.ord[j] = m->ord; b.type[j] = m->type; b.table[j] = m->table;
+              b.key[j] = m->key; b.ver[j] = m->ver;
+              memcpy(&b.val[size_t(j) * kValSize], m->val, kValSize);
+            }
+          }
+          b.src[j] = srcs[i];
+          if (b.count == width_) { Publish(cur); cur = -1; }
+        }
+      }
+      // flush a partial batch that has waited long enough
+      if (cur >= 0 && bufs_[cur].count > 0 &&
+          std::chrono::duration_cast<std::chrono::microseconds>(
+              now - first_pkt_t).count() >= flush_us_) {
+        Publish(cur);
+        cur = -1;
+      }
+    }
+  }
+
+  int TakeFree() {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (free_.empty()) return -1;
+    int s = free_.front();
+    free_.pop_front();
+    return s;
+  }
+
+  void Publish(int slot) {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      ready_.push_back(slot);
+      batches_.fetch_add(1);
+    }
+    cv_.notify_one();
+  }
+
+  uint32_t width_, flush_us_;
+  int fmt_, fd_ = -1;
+  uint16_t port_ = 0;
+  bool bound_ok_ = false;
+  std::vector<BatchBuf> bufs_;
+  std::deque<uint32_t> free_, ready_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::thread rx_;
+  std::atomic<bool> stop_{false};
+  std::atomic<uint64_t> pkts_rx_{0}, pkts_tx_{0}, batches_{0}, dropped_{0};
+};
+
+// Synthetic client: the coordinator side of the 1-RTT request/reply
+// protocol (reference clients batch per shard and wait for all replies,
+// smallbank/caladan/client_ebpf_shard.cc:287-325). Exchange = sendmmsg all,
+// recvmmsg until n replies or timeout.
+class Client {
+ public:
+  Client(const char* ip, uint16_t port, int fmt) : fmt_(fmt) {
+    fd_ = socket(AF_INET, SOCK_DGRAM, 0);
+    int buf = 1 << 26;
+    setsockopt(fd_, SOL_SOCKET, SO_RCVBUF, &buf, sizeof(buf));
+    setsockopt(fd_, SOL_SOCKET, SO_SNDBUF, &buf, sizeof(buf));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(port);
+    addr.sin_addr.s_addr = inet_addr(ip);
+    connect(fd_, (sockaddr*)&addr, sizeof(addr));
+  }
+  ~Client() { close(fd_); }
+
+  int Exchange(uint32_t n, const uint8_t* ord, const uint8_t* type,
+               const uint8_t* table, const uint64_t* key, const uint8_t* val,
+               const uint32_t* ver, uint8_t* r_ord, uint8_t* r_type,
+               uint8_t* r_table, uint64_t* r_key, uint8_t* r_val,
+               uint32_t* r_ver, uint32_t timeout_ms) {
+    const size_t wsz = wire_size(fmt_);
+    std::vector<uint8_t> wire(size_t(n) * wsz);
+    for (uint32_t i = 0; i < n; i++) {
+      uint8_t* w = wire.data() + size_t(i) * wsz;
+      switch (fmt_) {
+        case LOCK6: {
+          auto* m = (WireLock6*)w;
+          m->action = type[i]; m->lid = (uint32_t)key[i];
+          m->type = table ? table[i] : 0;
+          break;
+        }
+        case FASST9: {
+          auto* m = (WireFasst9*)w;
+          m->type = type[i]; m->lid = (uint32_t)key[i];
+          m->ver = ver ? ver[i] : 0;
+          break;
+        }
+        case LOG53: {
+          auto* m = (WireLog53*)w;
+          m->type = type[i]; m->key = key[i];
+          if (val) memcpy(m->val, val + size_t(i) * kValSize, kValSize);
+          else memset(m->val, 0, kValSize);
+          m->ver = ver ? ver[i] : 0;
+          break;
+        }
+        default: {
+          auto* m = (WireMsg55*)w;
+          m->ord = ord ? ord[i] : (uint8_t)i;
+          m->type = type[i];
+          m->table = table ? table[i] : 0;
+          m->key = key[i];
+          if (val) memcpy(m->val, val + size_t(i) * kValSize, kValSize);
+          else memset(m->val, 0, kValSize);
+          m->ver = ver ? ver[i] : 0;
+        }
+      }
+    }
+    std::vector<mmsghdr> hdrs(n);
+    std::vector<iovec> iovs(n);
+    for (uint32_t i = 0; i < n; i++) {
+      iovs[i] = {wire.data() + size_t(i) * wsz, wsz};
+      hdrs[i] = mmsghdr{};
+      hdrs[i].msg_hdr.msg_iov = &iovs[i];
+      hdrs[i].msg_hdr.msg_iovlen = 1;
+    }
+    uint32_t sent = 0;
+    while (sent < n) {
+      int r = sendmmsg(fd_, hdrs.data() + sent, n - sent, 0);
+      if (r <= 0) break;
+      sent += r;
+    }
+
+    // receive until n replies or deadline
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(timeout_ms);
+    timeval tv{0, 2000};
+    setsockopt(fd_, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    uint32_t got = 0;
+    std::vector<uint8_t> rwire(size_t(n) * wsz);
+    while (got < n && std::chrono::steady_clock::now() < deadline) {
+      for (uint32_t i = got; i < n; i++) {
+        iovs[i] = {rwire.data() + size_t(i) * wsz, wsz};
+        hdrs[i] = mmsghdr{};
+        hdrs[i].msg_hdr.msg_iov = &iovs[i];
+        hdrs[i].msg_hdr.msg_iovlen = 1;
+      }
+      int r = recvmmsg(fd_, hdrs.data() + got, n - got, 0, nullptr);
+      if (r <= 0) continue;
+      got += r;
+    }
+    for (uint32_t i = 0; i < got; i++) {
+      const uint8_t* w = rwire.data() + size_t(i) * wsz;
+      switch (fmt_) {
+        case LOCK6: {
+          auto* m = (const WireLock6*)w;
+          r_ord[i] = 0; r_type[i] = m->action; r_table[i] = m->type;
+          r_key[i] = m->lid; r_ver[i] = 0;
+          memset(r_val + size_t(i) * kValSize, 0, kValSize);
+          break;
+        }
+        case FASST9: {
+          auto* m = (const WireFasst9*)w;
+          r_ord[i] = 0; r_type[i] = m->type; r_table[i] = 0;
+          r_key[i] = m->lid; r_ver[i] = m->ver;
+          memset(r_val + size_t(i) * kValSize, 0, kValSize);
+          break;
+        }
+        case LOG53: {
+          auto* m = (const WireLog53*)w;
+          r_ord[i] = 0; r_type[i] = m->type; r_table[i] = 0;
+          r_key[i] = m->key; r_ver[i] = m->ver;
+          memcpy(r_val + size_t(i) * kValSize, m->val, kValSize);
+          break;
+        }
+        default: {
+          auto* m = (const WireMsg55*)w;
+          r_ord[i] = m->ord; r_type[i] = m->type; r_table[i] = m->table;
+          r_key[i] = m->key; r_ver[i] = m->ver;
+          memcpy(r_val + size_t(i) * kValSize, m->val, kValSize);
+        }
+      }
+    }
+    return (int)got;
+  }
+
+ private:
+  int fd_, fmt_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* shim_server_create(const char* ip, uint16_t port, uint32_t width,
+                         uint32_t flush_us, uint32_t nrings, int fmt) {
+  auto* s = new Server(ip, port, width, flush_us, nrings, fmt);
+  if (!s->ok()) { delete s; return nullptr; }
+  return s;
+}
+uint16_t shim_server_port(void* h) { return ((Server*)h)->port(); }
+int shim_server_poll(void* h, uint32_t timeout_us, View* out) {
+  return ((Server*)h)->Poll(timeout_us, out);
+}
+int shim_server_reply(void* h, uint32_t slot, const uint8_t* rtype,
+                      const uint8_t* rval, const uint32_t* rver) {
+  return ((Server*)h)->Reply(slot, rtype, rval, rver);
+}
+void shim_server_stats(void* h, uint64_t out[4]) { ((Server*)h)->Stats(out); }
+void shim_server_destroy(void* h) { delete (Server*)h; }
+
+void* shim_client_create(const char* ip, uint16_t port, int fmt) {
+  return new Client(ip, port, fmt);
+}
+int shim_client_exchange(void* h, uint32_t n, const uint8_t* ord,
+                         const uint8_t* type, const uint8_t* table,
+                         const uint64_t* key, const uint8_t* val,
+                         const uint32_t* ver, uint8_t* r_ord, uint8_t* r_type,
+                         uint8_t* r_table, uint64_t* r_key, uint8_t* r_val,
+                         uint32_t* r_ver, uint32_t timeout_ms) {
+  return ((Client*)h)->Exchange(n, ord, type, table, key, val, ver, r_ord,
+                                r_type, r_table, r_key, r_val, r_ver,
+                                timeout_ms);
+}
+void shim_client_destroy(void* h) { delete (Client*)h; }
+
+}  // extern "C"

@@ -1,0 +1,106 @@
+"""End-to-end tests of the native host shim (C++ UDP pump) over loopback:
+reference-wire-format requests in, engine-certified replies out. This is
+the cross-layer test the reference runs only on a real cluster (SURVEY.md
+§4.3); here the whole L0->L2 path runs in-process over 127.0.0.1."""
+import numpy as np
+import pytest
+
+from dint_tpu.engines import lock2pl, logsrv, store
+from dint_tpu.shim import (FMT_LOCK6, FMT_LOG53, LOCK2PL, LOG, STORE,
+                           EnginePump, ShimClient)
+from dint_tpu.tables import kv, locks
+from dint_tpu.tables import log as logring
+
+
+@pytest.fixture
+def store_pump():
+    table = kv.create(1 << 8, val_words=10)
+    with EnginePump(STORE, store.step, table, width=256,
+                    flush_us=2000).start() as p:
+        yield p
+
+
+def test_store_wire_roundtrip(store_pump):
+    with ShimClient("127.0.0.1", store_pump.port) as c:
+        n = 32
+        keys = np.arange(1, n + 1, dtype=np.uint64)
+        vals = np.zeros((n, 40), np.uint8)
+        vals[:, 0] = np.arange(n)
+        vals[:, 1] = 0xAB  # magic-byte convention, store/caladan/client_caladan.cc:160
+        # INSERT (wire type 2) everything in one exchange
+        r = c.exchange(np.full(n, 2, np.uint8), keys, vals=vals,
+                       timeout_ms=5000)
+        assert r["n"] == n
+        assert (r["type"] == 8).all()  # INSERT_ACK
+        # READ (wire type 0) them back
+        r = c.exchange(np.zeros(n, np.uint8), keys, timeout_ms=5000)
+        assert r["n"] == n
+        assert (r["type"] == 3).all()  # GRANT_READ
+        got = {int(k): (v[0], v[1]) for k, v in zip(r["key"], r["val"])}
+        for i, k in enumerate(keys):
+            assert got[int(k)] == (i, 0xAB)
+        # READ a missing key -> NOT_EXIST (7)
+        r = c.exchange(np.zeros(1, np.uint8), np.array([999], np.uint64),
+                       timeout_ms=5000)
+        assert r["n"] == 1 and r["type"][0] == 7
+
+
+def test_store_set_bumps_version(store_pump):
+    with ShimClient("127.0.0.1", store_pump.port) as c:
+        key = np.array([7], np.uint64)
+        c.exchange(np.array([2], np.uint8), key, timeout_ms=5000)  # INSERT
+        r1 = c.exchange(np.array([1], np.uint8), key, timeout_ms=5000)  # SET
+        assert r1["type"][0] == 5  # SET_ACK
+        r2 = c.exchange(np.array([0], np.uint8), key, timeout_ms=5000)  # READ
+        assert r2["ver"][0] == r1["ver"][0]
+        assert r2["ver"][0] >= 1
+
+
+def test_lock2pl_wire(rng):
+    table = locks.create_sx(1 << 10)
+    with EnginePump(LOCK2PL, lock2pl.step, table, width=64,
+                    flush_us=2000).start() as p:
+        with ShimClient("127.0.0.1", p.port, fmt=FMT_LOCK6) as c:
+            lid = np.array([42], np.uint64)
+            # ACQUIRE (0) shared (table byte 0) -> GRANT_LOCK (2)
+            r = c.exchange(np.zeros(1, np.uint8), lid, timeout_ms=5000)
+            assert r["n"] == 1 and r["type"][0] == 2
+            # ACQUIRE exclusive (table byte 1) on same lid -> REJECT_LOCK (3)
+            r = c.exchange(np.zeros(1, np.uint8), lid,
+                           tables=np.ones(1, np.uint8), timeout_ms=5000)
+            assert r["type"][0] == 3
+            # RELEASE (1) shared -> RELEASE_ACK (5); then X grant succeeds
+            r = c.exchange(np.ones(1, np.uint8), lid, timeout_ms=5000)
+            assert r["type"][0] == 5
+            r = c.exchange(np.zeros(1, np.uint8), lid,
+                           tables=np.ones(1, np.uint8), timeout_ms=5000)
+            assert r["type"][0] == 2
+
+
+def test_log_wire(rng):
+    ring = logring.create(4, 1 << 8, val_words=10)
+    with EnginePump(LOG, logsrv.step, ring, width=64,
+                    flush_us=2000).start() as p:
+        with ShimClient("127.0.0.1", p.port, fmt=FMT_LOG53) as c:
+            n = 16
+            keys = rng.integers(0, 1000, n).astype(np.uint64)
+            vals = rng.integers(0, 256, (n, 40)).astype(np.uint8)
+            r = c.exchange(np.zeros(n, np.uint8), keys, vals=vals,
+                           vers=np.arange(n, dtype=np.uint32),
+                           timeout_ms=5000)
+            assert r["n"] == n
+            assert (r["type"] == 1).all()  # ACK
+
+
+def test_pump_batches_full_width():
+    """A single exchange wider than flush granularity still round-trips."""
+    table = kv.create(1 << 10, val_words=10)
+    with EnginePump(STORE, store.step, table, width=512,
+                    flush_us=1000).start() as p:
+        with ShimClient("127.0.0.1", p.port) as c:
+            n = 512
+            keys = np.arange(1, n + 1, dtype=np.uint64)
+            r = c.exchange(np.full(n, 2, np.uint8), keys, timeout_ms=10000)
+            assert r["n"] == n
+            assert (r["type"] == 8).all()
+        assert p.server.stats()["pkts_rx"] >= n
