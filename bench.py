@@ -4,11 +4,15 @@ Prints exactly ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
 Protocol mirrors the reference's measurement contract (BASELINE.md): TATP
-mix 35/35/10/2/14/2/2, NURand subscriber ids, warmup then timed window,
-committed (goodput) txns/s. Baseline constant: the reference repo publishes
-no numbers (BASELINE.md "Published numbers: None"); we use 3.0e6 txn/s as a
-stand-in for tatp/ebpf on one r650 (paper-scale estimate) until measured
-side by side.
+mix 35/35/10/2/14/2/2, NURand subscriber ids, 3 replicated shards
+(primary-backup, log x3 + bck x2 + prim commit pipeline), warmup then timed
+window, committed (goodput) txns/s. The whole coordinator pipeline runs
+on-device (engines/tatp_pipeline.py) — the TPU-first equivalent of the
+reference's client coordinator + 3 eBPF servers on one machine boundary.
+
+Baseline constant: the reference repo publishes no numbers (BASELINE.md
+"Published numbers: None"); we use 3.0e6 txn/s as a stand-in for tatp/ebpf
+on one r650 (paper-scale estimate) until measured side by side.
 """
 from __future__ import annotations
 
@@ -16,33 +20,56 @@ import json
 import sys
 import time
 
+import jax
 import numpy as np
 
 ASSUMED_BASELINE = 3.0e6  # committed txn/s, tatp/ebpf single-server estimate
 
+N_SUBSCRIBERS = 100_000
+WIDTH = 8192              # txns per cohort
+BLOCK = 16                # cohorts per device dispatch
+VAL_WORDS = 10
+WINDOW_S = 10.0
+
 
 def main():
     from dint_tpu.clients import tatp_client as tc
+    from dint_tpu.engines import tatp_pipeline as tp
 
     rng = np.random.default_rng(0)
-    n_subscribers = 100_000
-    cohort = 4096
-    shards, _ = tc.populate_shards(rng, n_subscribers, val_words=10,
+    shards, _ = tc.populate_shards(rng, N_SUBSCRIBERS, val_words=VAL_WORDS,
                                    cf_buckets=1 << 19, cf_lock_slots=1 << 19)
-    coord = tc.Coordinator(shards, n_subscribers, width=8192, val_words=10)
+    stacked = tp.stack_shards(shards)
+    run = tp.build_runner(N_SUBSCRIBERS, w=WIDTH, val_words=VAL_WORDS,
+                          cohorts_per_block=BLOCK)
+    key = jax.random.PRNGKey(0)
 
-    # warmup (compile all wave shapes)
-    for _ in range(3):
-        coord.run_cohort(rng, cohort)
+    # warmup: compile + first blocks. NOTE: on the axon platform
+    # jax.block_until_ready returns early; a VALUE FETCH is the only honest
+    # sync (see .claude/skills/verify/SKILL.md), so the window is bracketed
+    # by np.asarray fetches.
+    stacked, stats = run(stacked, jax.random.fold_in(key, 0))
+    np.asarray(stats)
+    stacked, stats = run(stacked, jax.random.fold_in(key, 1))
+    np.asarray(stats)
 
-    base_committed = coord.stats.committed
+    total = np.zeros(tp.N_STATS, np.int64)
     t0 = time.time()
-    window = 10.0
-    while time.time() - t0 < window:
-        coord.run_cohort(rng, cohort)
+    i = 2
+    pending = None
+    while time.time() - t0 < WINDOW_S:
+        stacked, stats = run(stacked, jax.random.fold_in(key, i))
+        if pending is not None:            # overlap host sum with device work
+            total += np.asarray(pending, np.int64).sum(axis=0)
+        pending = stats
+        i += 1
+    total += np.asarray(pending, np.int64).sum(axis=0)   # fetch = real sync
     dt = time.time() - t0
-    committed = coord.stats.committed - base_committed
+
+    committed = int(total[tp.STAT_COMMITTED])
+    attempted = int(total[tp.STAT_ATTEMPTED])
     tps = committed / dt
+    assert int(total[tp.STAT_MAGIC_BAD]) == 0
 
     print(json.dumps({
         "metric": "tatp_committed_txns_per_sec",
@@ -50,8 +77,8 @@ def main():
         "unit": "txn/s",
         "vs_baseline": round(tps / ASSUMED_BASELINE, 4),
     }))
-    print(f"abort_rate={coord.stats.abort_rate:.4f} attempted={coord.stats.attempted}",
-          file=sys.stderr)
+    print(f"abort_rate={1 - committed / attempted:.4f} attempted={attempted} "
+          f"blocks={i - 2} window_s={dt:.2f}", file=sys.stderr)
 
 
 if __name__ == "__main__":

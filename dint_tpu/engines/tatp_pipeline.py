@@ -1,0 +1,316 @@
+"""Device-fused TATP transaction pipeline: whole txns in one jitted step.
+
+The reference's client-side coordinator (tatp/caladan/client_ebpf_shard.cc)
+drives each transaction through 5+ network RTTs against 3 replicated shard
+servers (read+lock -> validate -> CommitLog x3 -> CommitBck x2 -> CommitPrim,
+SURVEY.md §3.3). The host-side port of that coordinator
+(clients/tatp_client.py) keeps the same wave structure but pays a
+host<->device round trip per wave — which dominates when the TPU sits behind
+a network tunnel.
+
+This module is the TPU-first re-design: the *entire* cohort pipeline —
+workload generation (NURand ids, txn mix), per-shard routing, all three
+certification waves, replication fan-out, and abort accounting — runs inside
+one jitted function over the 3 shard replicas (vmapped `tatp.step`), and a
+`lax.scan` runs many cohorts per dispatch. Host traffic per scan block is one
+RNG key in and one small stats matrix out.
+
+The 3 "servers" are a stacked leading axis on the Shard pytree. A lane's
+op differs per shard (NOP unless routed there; PRIM at the owner vs BCK at
+backups), which is exactly the reference's per-shard message batches
+(client_ebpf_shard.cc:636-641) — expressed as a [3, R] op array instead of
+3 socket fan-outs.
+
+Wave structure per cohort (3 vmapped steps total):
+  wave 1  [R=4w lanes]  OCC_READ read-set + OCC_LOCK write-set at owners
+  wave 2  [R lanes]     validate: re-read read-set of surviving RW txns
+  wave 3  [4w lanes]    log block (COMMIT/DELETE_LOG on all shards) +
+                        role block (PRIM at owner / BCK at backups / ABORT
+                        of granted locks of dead txns at owner)
+
+Abort semantics mirror clients/tatp_client.py lane for lane (which itself
+mirrors client_ebpf_shard.cc:608-900); stats categories are disjoint:
+ab_lock (write-set lock rejected), ab_missing (required row absent /
+insert-exists), ab_validate (read-set version changed).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..clients import workloads as wl
+from . import tatp
+from .types import Batch, Op, PAD_KEY, Reply
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+N_SHARDS = 3
+K = 4                  # wave-1 lanes per txn
+MAGIC = 0x7A79         # parity with clients/tatp_client.py
+
+# stats vector layout
+STAT_ATTEMPTED = 0
+STAT_COMMITTED = 1
+STAT_AB_LOCK = 2
+STAT_AB_MISSING = 3
+STAT_AB_VALIDATE = 4
+STAT_MAGIC_BAD = 5
+N_STATS = 6
+
+
+def stack_shards(shards) -> tatp.Shard:
+    """[Shard] * 3 -> one Shard pytree with leading [3] device axis."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *shards)
+
+
+def _broadcast_batch(op_s, table, key_lo, val, ver):
+    """Per-shard op array [S, R] + shared lane fields [R] -> stacked Batch."""
+    s = op_s.shape[0]
+
+    def bc(x):
+        return jnp.broadcast_to(x[None], (s,) + x.shape)
+
+    return Batch(op=op_s, table=bc(table),
+                 key_hi=bc(jnp.zeros_like(key_lo)), key_lo=bc(key_lo),
+                 val=bc(val), ver=bc(ver))
+
+
+def _merge(owner, stacked):
+    """Pick each lane's reply from its owner shard: [S, R...] -> [R...]."""
+    r = owner.shape[0]
+    return stacked[owner, jnp.arange(r)]
+
+
+def gen_cohort(key, w: int, n_sub: int):
+    """On-device workload generation (tatp/caladan/tatp.h:40-63).
+
+    Returns (ttype [w], lane ops/tbl/keys [w, K], write-slot arrays [w, 2]).
+    """
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    ttype = jax.random.choice(k1, 7, shape=(w,), p=jnp.asarray(wl.TATP_MIX))
+    # NURand: ((x | y) % n) + 1
+    x = jax.random.randint(k2, (w,), 0, wl.TATP_A + 1, dtype=I32)
+    y = jax.random.randint(k3, (w,), 1, n_sub + 1, dtype=I32)
+    s_id = ((x | y) % n_sub) + 1
+    kx = jax.random.randint(k4, (w, 2), 0, 12, dtype=I32)
+    xtype = kx[:, 0] % 4 + 1                  # ai_type / sf_type 1..4
+    stime = (kx[:, 1] % 3) * 8                # 0 / 8 / 16
+
+    sf_idx = s_id * 4 + (xtype - 1)
+    ai_idx = sf_idx
+    cfk = tatp.cf_key(s_id, xtype, stime)
+
+    T = tatp
+    t = ttype
+    ops = jnp.zeros((w, K), I32)
+    tbl = jnp.zeros((w, K), I32)
+    kk = jnp.zeros((w, K), I32)
+
+    def put(ops, tbl, kk, mask, lane, op, tb, keyv):
+        ops = ops.at[:, lane].set(jnp.where(mask, op, ops[:, lane]))
+        tbl = tbl.at[:, lane].set(jnp.where(mask, tb, tbl[:, lane]))
+        kk = kk.at[:, lane].set(jnp.where(mask, keyv, kk[:, lane]))
+        return ops, tbl, kk
+
+    m = t == wl.TATP_GET_SUBSCRIBER
+    ops, tbl, kk = put(ops, tbl, kk, m, 0, Op.OCC_READ, T.SUBSCRIBER, s_id)
+    m = t == wl.TATP_GET_ACCESS
+    ops, tbl, kk = put(ops, tbl, kk, m, 0, Op.OCC_READ, T.ACCESS_INFO, ai_idx)
+    m = t == wl.TATP_GET_NEW_DEST
+    ops, tbl, kk = put(ops, tbl, kk, m, 0, Op.OCC_READ, T.SPECIAL_FACILITY, sf_idx)
+    ops, tbl, kk = put(ops, tbl, kk, m, 1, Op.OCC_READ, T.CALL_FORWARDING, cfk)
+    m = t == wl.TATP_UPDATE_SUBSCRIBER
+    ops, tbl, kk = put(ops, tbl, kk, m, 0, Op.OCC_READ, T.SUBSCRIBER, s_id)
+    ops, tbl, kk = put(ops, tbl, kk, m, 1, Op.OCC_READ, T.SPECIAL_FACILITY, sf_idx)
+    ops, tbl, kk = put(ops, tbl, kk, m, 2, Op.OCC_LOCK, T.SUBSCRIBER, s_id)
+    ops, tbl, kk = put(ops, tbl, kk, m, 3, Op.OCC_LOCK, T.SPECIAL_FACILITY, sf_idx)
+    m = t == wl.TATP_UPDATE_LOCATION
+    ops, tbl, kk = put(ops, tbl, kk, m, 0, Op.OCC_READ, T.SEC_SUBSCRIBER, s_id)
+    ops, tbl, kk = put(ops, tbl, kk, m, 1, Op.OCC_READ, T.SUBSCRIBER, s_id)
+    ops, tbl, kk = put(ops, tbl, kk, m, 2, Op.OCC_LOCK, T.SUBSCRIBER, s_id)
+    m = t == wl.TATP_INSERT_CF
+    ops, tbl, kk = put(ops, tbl, kk, m, 0, Op.OCC_READ, T.SPECIAL_FACILITY, sf_idx)
+    ops, tbl, kk = put(ops, tbl, kk, m, 1, Op.OCC_READ, T.CALL_FORWARDING, cfk)
+    ops, tbl, kk = put(ops, tbl, kk, m, 2, Op.OCC_LOCK, T.CALL_FORWARDING, cfk)
+    m = t == wl.TATP_DELETE_CF
+    ops, tbl, kk = put(ops, tbl, kk, m, 0, Op.OCC_READ, T.CALL_FORWARDING, cfk)
+    ops, tbl, kk = put(ops, tbl, kk, m, 1, Op.OCC_LOCK, T.CALL_FORWARDING, cfk)
+
+    # write slots (== lock lanes): (active, lane_idx, table, key, kind)
+    # kind: 0 = commit (dense install), 1 = insert (CF), 2 = delete (CF)
+    is_us = t == wl.TATP_UPDATE_SUBSCRIBER
+    is_ul = t == wl.TATP_UPDATE_LOCATION
+    is_ic = t == wl.TATP_INSERT_CF
+    is_dc = t == wl.TATP_DELETE_CF
+    ws_active = jnp.stack([is_us | is_ul | is_ic | is_dc, is_us], axis=1)
+    ws_lane = jnp.stack([jnp.where(is_dc, 1, 2), jnp.full((w,), 3, I32)], axis=1)
+    ws_tbl = jnp.stack([
+        jnp.where(is_us | is_ul, T.SUBSCRIBER, T.CALL_FORWARDING),
+        jnp.full((w,), T.SPECIAL_FACILITY, I32)], axis=1)
+    ws_key = jnp.stack([
+        jnp.where(is_us | is_ul, s_id, cfk), sf_idx], axis=1)
+    ws_kind = jnp.stack([
+        jnp.where(is_ic, 1, jnp.where(is_dc, 2, 0)),
+        jnp.zeros((w,), I32)], axis=1)
+    return ttype, ops, tbl, kk, (ws_active, ws_lane, ws_tbl, ws_key, ws_kind)
+
+
+def cohort_step(stacked: tatp.Shard, key, *, w: int, n_sub: int,
+                val_words: int, validate: bool = True):
+    """One full cohort of w txns against the 3 stacked replicas.
+
+    ``validate`` (static) keeps the reference protocol's wave-2 read-set
+    re-read (client_ebpf_shard.cc:688-768). In this fused pipeline it is
+    *protocol-parity ballast*: cohorts serialize on the device, no commit can
+    land between a txn's read and its validation, so ab_validate is
+    structurally 0 — the wave is kept (and benchmarked) to pay the same
+    per-txn work the reference client pays. ``validate=False`` is the
+    TPU-first fast path: batch lock certification subsumes validation, a
+    design win the reference cannot express.
+
+    Returns (stacked', stats [N_STATS] i32)."""
+    step_v = jax.vmap(tatp.step)
+    kg, kv = jax.random.split(key)
+    ttype, ops, tbl, kk, ws = gen_cohort(kg, w, n_sub)
+    ws_active, ws_lane, ws_tbl, ws_key, ws_kind = ws
+    r = w * K
+
+    lane_op = ops.reshape(r)
+    lane_tbl = tbl.reshape(r)
+    lane_key = kk.reshape(r).astype(U32)
+    used = lane_op != Op.NOP
+    # NOP lanes get the pad key so they never join a real key's segment
+    lane_key = jnp.where(used, lane_key, U32(PAD_KEY & 0xFFFFFFFF))
+    owner = (kk.reshape(r) % N_SHARDS).astype(I32)
+    sid = jnp.arange(N_SHARDS, dtype=I32)
+
+    zval = jnp.zeros((r, val_words), U32)
+    zver = jnp.zeros((r,), U32)
+
+    # ---- wave 1: read + lock at owners ------------------------------------
+    op_s = jnp.where((owner[None] == sid[:, None]) & used[None],
+                     lane_op[None], Op.NOP)
+    stacked, rep1 = step_v(stacked, _broadcast_batch(op_s, lane_tbl, lane_key,
+                                                     zval, zver))
+    rt1 = _merge(owner, rep1.rtype).reshape(w, K)
+    rv1 = _merge(owner, rep1.val)
+    rver1 = _merge(owner, rep1.ver).reshape(w, K)
+
+    is_val_lane = rt1.reshape(r) == Reply.VAL
+    magic_bad = jnp.sum(is_val_lane & (rv1[:, 1] != MAGIC), dtype=I32)
+
+    # ---- outcome of wave 1 -------------------------------------------------
+    t = ttype
+    is_ro = ((t == wl.TATP_GET_SUBSCRIBER) | (t == wl.TATP_GET_ACCESS)
+             | (t == wl.TATP_GET_NEW_DEST))
+    rw = ~is_ro
+
+    ws_rt = jnp.take_along_axis(rt1, ws_lane, axis=1)      # [w, 2]
+    granted = ws_active & (ws_rt == Reply.GRANT)
+    lock_rejected = (ws_active & (ws_rt == Reply.REJECT)).any(axis=1)
+
+    missing = jnp.zeros((w,), bool)
+    m = t == wl.TATP_GET_NEW_DEST
+    missing |= m & (rt1[:, 0] != Reply.VAL)
+    m = (t == wl.TATP_UPDATE_SUBSCRIBER) | (t == wl.TATP_UPDATE_LOCATION)
+    missing |= m & ((rt1[:, 0] != Reply.VAL) | (rt1[:, 1] != Reply.VAL))
+    m = t == wl.TATP_INSERT_CF
+    missing |= m & ((rt1[:, 0] != Reply.VAL) | (rt1[:, 1] == Reply.VAL))
+    m = t == wl.TATP_DELETE_CF
+    missing |= m & (rt1[:, 0] != Reply.VAL)
+
+    ab_lock = rw & lock_rejected
+    ab_missing = rw & ~lock_rejected & missing
+    alive = rw & ~lock_rejected & ~missing
+
+    # ---- wave 2: validate read-set of surviving RW txns --------------------
+    if validate:
+        is_read_lane = (ops == Op.OCC_READ) & alive[:, None]
+        v_op = jnp.where(is_read_lane.reshape(r), Op.OCC_READ, Op.NOP)
+        v_used = v_op != Op.NOP
+        v_key = jnp.where(v_used, kk.reshape(r).astype(U32),
+                          U32(PAD_KEY & 0xFFFFFFFF))
+        op_s2 = jnp.where((owner[None] == sid[:, None]) & v_used[None],
+                          v_op[None], Op.NOP)
+        stacked, rep2 = step_v(stacked, _broadcast_batch(op_s2, lane_tbl,
+                                                         v_key, zval, zver))
+        vrt = _merge(owner, rep2.rtype).reshape(w, K)
+        vver = _merge(owner, rep2.ver).reshape(w, K)
+        bad_lane = is_read_lane & (
+            (vver != rver1) | ((vrt != Reply.VAL) & (rt1 == Reply.VAL)))
+        changed = bad_lane.any(axis=1)
+    else:
+        changed = jnp.zeros((w,), bool)
+    ab_validate = alive & changed
+    alive = alive & ~changed
+
+    # ---- wave 3: log block + role block (prim/bck/abort) -------------------
+    # lanes: [log ws0 | log ws1 | role ws0 | role ws1], each w wide
+    w_owner = (ws_key % N_SHARDS).astype(I32)              # [w, 2]
+    do_write = ws_active & alive[:, None]
+    newval = jnp.zeros((w, 2, val_words), U32)
+    payload = jax.random.randint(kv, (w, 2), 0, 1 << 16, dtype=I32)
+    newval = newval.at[:, :, 0].set(payload.astype(U32))
+    newval = newval.at[:, :, 1].set(jnp.where(do_write, U32(MAGIC), U32(0)))
+
+    log_op = jnp.where(do_write,
+                       jnp.where(ws_kind == 2, Op.DELETE_LOG, Op.COMMIT_LOG),
+                       Op.NOP)                              # [w, 2], all shards
+    prim_op = jnp.select([ws_kind == 1, ws_kind == 2],
+                         [Op.INSERT_PRIM, Op.DELETE_PRIM], Op.COMMIT_PRIM)
+    bck_op = jnp.select([ws_kind == 1, ws_kind == 2],
+                        [Op.INSERT_BCK, Op.DELETE_BCK], Op.COMMIT_BCK)
+    # role op per shard s: owner -> prim; others -> bck; dead+granted -> ABORT
+    dead_abort = granted & ~alive[:, None]
+    role_s = jnp.where(
+        do_write[None], jnp.where(w_owner[None] == sid[:, None, None],
+                                  prim_op[None], bck_op[None]),
+        jnp.where(dead_abort[None] & (w_owner[None] == sid[:, None, None]),
+                  Op.ABORT, Op.NOP))                        # [S, w, 2]
+
+    c_used = do_write | dead_abort
+    c_key = jnp.where(c_used, ws_key.astype(U32), U32(PAD_KEY & 0xFFFFFFFF))
+    lane3_key = jnp.concatenate([c_key[:, 0], c_key[:, 1],
+                                 c_key[:, 0], c_key[:, 1]])
+    lane3_tbl = jnp.concatenate([ws_tbl[:, 0], ws_tbl[:, 1],
+                                 ws_tbl[:, 0], ws_tbl[:, 1]])
+    lane3_val = jnp.concatenate([newval[:, 0], newval[:, 1],
+                                 newval[:, 0], newval[:, 1]])
+    op3_s = jnp.concatenate([
+        jnp.broadcast_to(log_op[:, 0][None], (N_SHARDS, w)),
+        jnp.broadcast_to(log_op[:, 1][None], (N_SHARDS, w)),
+        role_s[:, :, 0], role_s[:, :, 1]], axis=1)
+    zver3 = jnp.zeros((w * 4,), U32)
+    stacked, _ = step_v(stacked, _broadcast_batch(
+        op3_s, lane3_tbl, lane3_key, lane3_val, zver3))
+
+    committed = (is_ro & ~missing) | alive
+    stats = jnp.stack([
+        jnp.asarray(w, I32),
+        committed.sum(dtype=I32),
+        ab_lock.sum(dtype=I32),
+        (ab_missing | (is_ro & missing)).sum(dtype=I32),
+        ab_validate.sum(dtype=I32),
+        magic_bad,
+    ])
+    return stacked, stats
+
+
+def build_runner(n_sub: int, w: int = 4096, val_words: int = 10,
+                 cohorts_per_block: int = 8, validate: bool = True):
+    """jit(scan(cohort_step)): one dispatch runs `cohorts_per_block` cohorts.
+
+    Returns run(stacked, key) -> (stacked', stats [cohorts_per_block, N_STATS]).
+    State is donated — tables update in place in HBM.
+    """
+    step = functools.partial(cohort_step, w=w, n_sub=n_sub,
+                             val_words=val_words, validate=validate)
+
+    def block(stacked, key):
+        keys = jax.random.split(key, cohorts_per_block)
+        return jax.lax.scan(step, stacked, keys)
+
+    return jax.jit(block, donate_argnums=0)

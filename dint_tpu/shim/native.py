@@ -52,7 +52,13 @@ def load() -> ctypes.CDLL:
             or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
         subprocess.run(["make", "-C", os.path.dirname(_SO)], check=True,
                        capture_output=True)
-    lib = ctypes.CDLL(_SO)
+    try:
+        lib = ctypes.CDLL(_SO)
+    except OSError:
+        # stale/foreign binary (e.g. built on another arch): force a rebuild
+        subprocess.run(["make", "-B", "-C", os.path.dirname(_SO)], check=True,
+                       capture_output=True)
+        lib = ctypes.CDLL(_SO)
     u8p = ctypes.POINTER(ctypes.c_uint8)
     u32p = ctypes.POINTER(ctypes.c_uint32)
     u64p = ctypes.POINTER(ctypes.c_uint64)
@@ -99,6 +105,7 @@ class ShimServer:
             raise OSError(f"shim: cannot bind UDP {ip}:{port}")
         self.width = width
         self.port = self._lib.shim_server_port(self._h)
+        self._pending: dict[int, int] = {}   # slot -> polled batch count
 
     def poll(self, timeout_us: int = 100_000):
         """Returns (slot, batch dict of numpy views) or None on timeout.
@@ -108,6 +115,7 @@ class ShimServer:
                                           ctypes.byref(v)):
             return None
         n = v.count
+        self._pending[v.slot] = n
         return v.slot, {
             "ord": _as_np(v.ord, n, np.uint8),
             "type": _as_np(v.type, n, np.uint8),
@@ -119,6 +127,11 @@ class ShimServer:
 
     def reply(self, slot: int, rtype, rval=None, rver=None):
         n = len(rtype)
+        expect = self._pending.pop(slot, None)
+        if expect is not None and n != expect:
+            raise ValueError(
+                f"reply() got {n} lanes for slot {slot}, poll() returned "
+                f"{expect} — C++ reads the full polled count")
         rtype = np.ascontiguousarray(rtype, np.uint8)
         if rval is None:
             rval = np.zeros((n, VAL_SIZE), np.uint8)

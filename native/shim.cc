@@ -405,6 +405,13 @@ class Client {
       hdrs[i].msg_hdr.msg_iov = &iovs[i];
       hdrs[i].msg_hdr.msg_iovlen = 1;
     }
+    // drain stale replies a previous timed-out exchange may have left queued
+    // on the connected socket, so they can't be returned as this exchange's
+    // replies
+    {
+      uint8_t scratch[512];
+      while (recv(fd_, scratch, sizeof scratch, MSG_DONTWAIT) > 0) {}
+    }
     uint32_t sent = 0;
     while (sent < n) {
       int r = sendmmsg(fd_, hdrs.data() + sent, n - sent, 0);

@@ -1,0 +1,89 @@
+"""Device-fused TATP pipeline: invariants + parity with the host coordinator.
+
+The fused pipeline (engines/tatp_pipeline.py) must preserve the host
+coordinator's semantics (clients/tatp_client.py): disjoint abort accounting,
+magic-byte integrity on every read, and — the replication contract — the 3
+replicas' table contents staying bit-identical after every cohort
+(SURVEY.md §2.3: every record on all 3 servers)."""
+import jax
+import numpy as np
+import pytest
+
+from dint_tpu.clients import tatp_client as tc
+from dint_tpu.engines import tatp, tatp_pipeline as tp
+
+
+@pytest.fixture(scope="module")
+def _stacked0():
+    rng = np.random.default_rng(7)
+    shards, _ = tc.populate_shards(rng, 64, val_words=4, cf_buckets=1 << 10,
+                                   cf_lock_slots=1 << 10)
+    return tp.stack_shards(shards)
+
+
+@pytest.fixture
+def stacked(_stacked0):
+    # runners donate their state argument; hand each test its own buffers
+    return jax.tree.map(jax.numpy.array, _stacked0)
+
+
+def _dense_replicas_equal(st: tatp.Shard):
+    for t in (st.sub, st.sec, st.ai, st.sf):
+        for arr in (t.val, t.ver):
+            a = np.asarray(arr)
+            assert (a[0] == a[1]).all() and (a[0] == a[2]).all()
+    for arr in (st.cf.key_hi, st.cf.key_lo, st.cf.ver, st.cf.valid):
+        a = np.asarray(arr)
+        assert (a[0] == a[1]).all() and (a[0] == a[2]).all()
+
+
+def test_cohorts_run_and_account(stacked):
+    run = tp.build_runner(64, w=128, val_words=4, cohorts_per_block=3)
+    key = jax.random.PRNGKey(0)
+    st = stacked
+    total = np.zeros(tp.N_STATS, np.int64)
+    for i in range(3):
+        st, stats = run(st, jax.random.fold_in(key, i))
+        total += np.asarray(stats, np.int64).sum(axis=0)
+
+    attempted = total[tp.STAT_ATTEMPTED]
+    assert attempted == 3 * 3 * 128
+    # disjoint accounting: every attempt is exactly one of these
+    assert (total[tp.STAT_COMMITTED] + total[tp.STAT_AB_LOCK]
+            + total[tp.STAT_AB_MISSING] + total[tp.STAT_AB_VALIDATE]
+            == attempted)
+    assert total[tp.STAT_MAGIC_BAD] == 0
+    assert total[tp.STAT_COMMITTED] > 0.5 * attempted
+    # replication contract: replicas stay bit-identical
+    _dense_replicas_equal(st)
+
+
+def test_no_locks_leak(stacked):
+    """After full cohorts (commits release at owner, aborts unlock), no row
+    lock may stay held between cohorts on any replica."""
+    run = tp.build_runner(64, w=128, val_words=4, cohorts_per_block=4)
+    st, _ = run(stacked, jax.random.PRNGKey(3))
+    for lock in (st.sub_lock, st.sec_lock, st.ai_lock, st.sf_lock):
+        assert not np.asarray(lock).any()
+    assert not np.asarray(st.cf_lock.locked).any()
+
+
+def test_abort_rate_matches_host_coordinator():
+    """Same workload params -> fused and host-wave abort rates agree within
+    noise (both serialize conflicts by per-cohort lock certification)."""
+    n_sub, w, iters = 48, 256, 6
+    rng = np.random.default_rng(11)
+    shards, _ = tc.populate_shards(rng, n_sub, val_words=4,
+                                   cf_buckets=1 << 10, cf_lock_slots=1 << 10)
+    coord = tc.Coordinator(shards, n_sub, width=2048, val_words=4)
+    for _ in range(iters):
+        coord.run_cohort(rng, w)
+
+    shards2, _ = tc.populate_shards(np.random.default_rng(11), n_sub,
+                                    val_words=4, cf_buckets=1 << 10,
+                                    cf_lock_slots=1 << 10)
+    run = tp.build_runner(n_sub, w=w, val_words=4, cohorts_per_block=iters)
+    _, stats = run(tp.stack_shards(shards2), jax.random.PRNGKey(5))
+    tot = np.asarray(stats, np.int64).sum(axis=0)
+    fused_rate = 1 - tot[tp.STAT_COMMITTED] / tot[tp.STAT_ATTEMPTED]
+    assert abs(fused_rate - coord.stats.abort_rate) < 0.08
