@@ -190,13 +190,27 @@ class Lock2PLClient(_SteppedClient):
 
 
 class FasstClient(_SteppedClient):
-    """FaSST OCC trace replay (lock_fasst/caladan/client.cc:184-280)."""
+    """FaSST OCC trace replay (lock_fasst/caladan/client.cc:184-280).
+
+    ``attribute=True`` runs the lock-attribution server variant
+    (engines.fasst.step_attr == tatp/ebpf/lock_kern.c) and keeps the
+    reference's conflict-attribution counters lock_cnt /
+    reject_sharing_cnt / reject_same_key_cnt
+    (tatp/caladan/client_lock.cc:62-64,768-771) in ``rec.extra``."""
 
     def __init__(self, trace, n_slots: int = 1 << 16, cohort: int = 512,
                  width: int = 8192, val_words: int = 1,
-                 rng: np.random.Generator | None = None):
-        super().__init__(locks.create_occ(n_slots), fasst.step, width, val_words)
+                 rng: np.random.Generator | None = None,
+                 attribute: bool = False):
+        state = (locks.create_occ_attr(n_slots) if attribute
+                 else locks.create_occ(n_slots))
+        step_fn = fasst.step_attr if attribute else fasst.step
+        super().__init__(state, step_fn, width, val_words)
         self.co = _TraceCohort(trace, cohort, rng or np.random.default_rng(2))
+        self.attribute = attribute
+        if attribute:
+            self.rec.extra.update(lock_cnt=0, reject_sharing_cnt=0,
+                                  reject_same_key_cnt=0)
 
     def run_round(self):
         keys, is_read, txn_of = _flatten(self.co.cur)
@@ -207,6 +221,12 @@ class FasstClient(_SteppedClient):
         rt, _, rver, _ = self._wave(ops, keys)
         lock_lane = ~is_read
         got_lock = rt == Reply.GRANT
+        if self.attribute:
+            self.rec.extra["lock_cnt"] += int(lock_lane.sum())
+            self.rec.extra["reject_sharing_cnt"] += int(
+                (lock_lane & (rt == Reply.REJECT)).sum())
+            self.rec.extra["reject_same_key_cnt"] += int(
+                (lock_lane & (rt == Reply.REJECT_SAME_KEY)).sum())
         lock_fail = np.zeros(w, bool)
         np.logical_or.at(lock_fail, txn_of, lock_lane & ~got_lock)
 

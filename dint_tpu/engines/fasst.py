@@ -65,3 +65,73 @@ def step(table: locks.OCCTable, batch: Batch):
     o_rtype, o_rver, o_rlocked = segments.unsort(sb, rtype, rver, rlocked)
     rval = jnp.zeros((r, batch.val.shape[1]), U32).at[:, 0].set(o_rlocked)
     return table, Replies(rtype=o_rtype, val=rval, ver=o_rver)
+
+
+def step_attr(table, batch: Batch):
+    """Lock-attribution variant (the reference's instrumented TATP server,
+    tatp/ebpf/lock_kern.c): the lock word carries its holder's key, and a
+    rejected LOCK reports REJECT_SAME_KEY when the holder's key equals the
+    requester's (true conflict) vs plain REJECT (hash-slot sharing,
+    lock_kern.c:292-298). State: tables.locks.OCCAttrTable."""
+    from ..tables.locks import OCCAttrTable  # noqa: F401  (type of `table`)
+
+    r = batch.width
+    slot = locks.lock_slot(batch.key_hi, batch.key_lo, table.n_slots)
+    sb = segments.sort_batch(jnp.zeros((r,), U32), slot.astype(U32))
+    op = batch.op[sb.perm]
+    k_hi = batch.key_hi[sb.perm]
+    k_lo = batch.key_lo[sb.perm]
+    s_slot = slot[sb.perm]
+
+    locked0 = table.locked[s_slot]
+    ver0 = table.ver[s_slot]
+    own_hi0 = table.owner_hi[s_slot]
+    own_lo0 = table.owner_lo[s_slot]
+
+    is_commit = op == Op.COMMIT_VER
+    is_abort = op == Op.ABORT
+    is_read = op == Op.READ_VER
+    is_lock = op == Op.LOCK
+
+    n_commits = segments.seg_sum(sb, is_commit.astype(I32))
+    unlock_any = segments.seg_any(sb, is_commit | is_abort)
+    ver1 = ver0 + n_commits.astype(U32)
+    locked1 = locked0 & ~unlock_any
+
+    first_lock = segments.first_rank_where(sb, is_lock)
+    grant = is_lock & ~locked1 & (sb.rank == first_lock)
+    new_locked = locked1 | segments.seg_any(sb, grant)
+    # owner after this batch: the granting lane's key, else the prior owner
+    pos_first = jnp.clip(sb.head_pos + first_lock, 0, r - 1)
+    won = segments.seg_any(sb, grant)
+    new_own_hi = jnp.where(won, k_hi[pos_first], own_hi0)
+    new_own_lo = jnp.where(won, k_lo[pos_first], own_lo0)
+    # the key a rejected LOCK lost to: pre-held -> table owner; freshly
+    # granted this batch -> the winning lane's key
+    lose_hi = jnp.where(locked1, own_hi0, new_own_hi)
+    lose_lo = jnp.where(locked1, own_lo0, new_own_lo)
+    same = (lose_hi == k_hi) & (lose_lo == k_lo)
+
+    rtype = jnp.full((r,), Reply.NONE, I32)
+    rtype = jnp.where(is_commit | is_abort, Reply.ACK, rtype)
+    rtype = jnp.where(is_read, Reply.VAL, rtype)
+    rtype = jnp.where(is_lock,
+                      jnp.where(grant, Reply.GRANT,
+                                jnp.where(same, Reply.REJECT_SAME_KEY,
+                                          Reply.REJECT)), rtype)
+    rver = jnp.where(is_read, ver1, U32(0))
+    rlocked = jnp.where(is_read, locked1.astype(U32), U32(0))
+
+    touched = op != Op.NOP
+    writer = sb.last & segments.seg_any(sb, touched)
+    table = table.replace(
+        locked=segments.scatter_rows(table.locked, s_slot, new_locked, writer),
+        ver=segments.scatter_rows(table.ver, s_slot, ver1, writer),
+        owner_hi=segments.scatter_rows(table.owner_hi, s_slot, new_own_hi,
+                                       writer),
+        owner_lo=segments.scatter_rows(table.owner_lo, s_slot, new_own_lo,
+                                       writer),
+    )
+    o_rtype, o_rver, o_rlocked = segments.unsort(sb, rtype, rver, rlocked)
+    rval = jnp.zeros((r, batch.val.shape[1]), U32).at[:, 0].set(o_rlocked)
+    return table, Replies(rtype=o_rtype, val=rval, ver=o_rver)

@@ -1,0 +1,578 @@
+"""Fused single-program TATP engine: 3 replicas as one flat device state.
+
+The TPU-first answer to a finding from profiling the stacked pipeline
+(engines/tatp_pipeline.py): vmapping a generic 5-table engine over 3 shard
+replicas costs ~4.5x one shard — every wave re-sorts, re-gathers each table
+separately, and runs install/alloc machinery on mostly-NOP lanes.
+
+Here the whole cluster state is flat arrays indexed by shard offset:
+
+  bank   u32 [3*NR, D]   all four dense tables of all three replicas;
+                         row = shard*NR + table_offset + local_idx,
+                         record = [val.. (VW), ver, lock]  (D = VW+2)
+  cf     u32 [3*NBC*SL, 2+VW]  CALL_FORWARDING single-hash 4-way table,
+                         row = (shard*NBC + h(key)) * SL + slot,
+                         record = [key_lo, ver, val..]; ver==0 <=> empty
+                         (the reference's per-table cache-map shape,
+                         tatp/ebpf/shard_kern.c:61-94)
+  cf_lock u32 [3*NLC]    OCC lock words, hash-conflated
+                         (tatp/ebpf/shard_kern.c:26-59)
+  log    u32 [3*L*CAP, EW] + heads [3*L]  per-replica append rings
+                         (log_server/ebpf/ls_kern.c:26-38)
+
+Replication is not a second program execution: a commit produces one lane
+per destination replica (prim at owner, bck at the other two), all certified
+in the same sorted pass — the reference client's CommitBck fan-out RTTs
+(SURVEY.md §3.3) become index arithmetic. One cohort = 3 sorted passes:
+
+  wave 1   [R=4w]  OCC_READ + OCC_LOCK at owner replicas
+  wave 2           validation re-read: bank/cf re-gather over wave 1's
+                   sort (protocol-parity; see tatp_pipeline.cohort_step)
+  wave 3   [6w]    log append x3 + {COMMIT,INSERT,DELETE}_{PRIM,BCK} and
+                   ABORT lanes, one lane per (write-slot, replica)
+
+CF lanes ride the same sorts: their sort key is (key << 2 | dest) offset
+past a sentinel, so they land in a fixed-width suffix window where a compact
+single-hash sub-engine probes/installs them. Window overflow lanes get
+REJECT (client-retry semantics) and are counted in stats; wave-3 windows are
+sized so overflow is effectively impossible at the TATP mix.
+"""
+from __future__ import annotations
+
+import functools
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..clients import workloads as wl
+from ..ops import hashing
+from . import tatp, tatp_pipeline as tp
+from .types import Op, Reply
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+S = 3            # replicas
+K = tp.K         # wave-1 lanes per txn
+SL = 4           # cf slots per bucket
+MAGIC = tp.MAGIC
+
+# sort-key spaces: dense rows < BIG_NOP < cf lanes at BIG_CF | key<<2 | dest
+BIG_NOP = jnp.uint32(0x4000_0000)
+BIG_CF = jnp.uint32(0x8000_0000)
+
+# stats layout = tatp_pipeline's + window-overflow counter
+N_STATS = tp.N_STATS + 1
+STAT_ATTEMPTED = tp.STAT_ATTEMPTED
+STAT_COMMITTED = tp.STAT_COMMITTED
+STAT_AB_LOCK = tp.STAT_AB_LOCK
+STAT_AB_MISSING = tp.STAT_AB_MISSING
+STAT_AB_VALIDATE = tp.STAT_AB_VALIDATE
+STAT_MAGIC_BAD = tp.STAT_MAGIC_BAD
+STAT_OVERFLOW = tp.N_STATS
+
+
+@flax.struct.dataclass
+class FusedState:
+    bank: jax.Array       # u32 [S*NR, D]
+    cf: jax.Array         # u32 [S*NBC*SL, 2+VW]
+    cf_lock: jax.Array    # u32 [S*NLC]
+    log: jax.Array        # u32 [S*L*CAP, EW]
+    log_head: jax.Array   # u32 [S*L]
+
+    @property
+    def val_words(self):
+        return self.bank.shape[1] - 2
+
+
+def _layout(n_sub: int):
+    p1 = n_sub + 1
+    # offsets inside one replica's bank: SUB, SEC, AI, SF
+    return p1, 10 * p1, (0, p1, 2 * p1, 6 * p1)
+
+
+def create(n_sub: int, val_words: int = 10, cf_buckets: int = 1 << 15,
+           cf_lock_slots: int = 1 << 15, log_lanes: int = 16,
+           log_capacity: int = 1 << 14, cf_slots: int = SL) -> FusedState:
+    _, nr, _ = _layout(n_sub)
+    ew = 4 + val_words
+    return FusedState(
+        bank=jnp.zeros((S * nr, val_words + 2), U32),
+        cf=jnp.zeros((S * cf_buckets * cf_slots, 2 + val_words), U32),
+        cf_lock=jnp.zeros((S * cf_lock_slots,), U32),
+        log=jnp.zeros((S * log_lanes * log_capacity, ew), U32),
+        log_head=jnp.zeros((S * log_lanes,), U32),
+    )
+
+
+def from_replicas(shards, n_sub: int, cf_buckets: int = 1 << 15,
+                  cf_lock_slots: int = 1 << 15, cf_slots: int = SL,
+                  **log_kw) -> FusedState:
+    """Convert tatp_client.populate_shards replicas into fused flat state
+    (numpy; used by tests for cross-engine equivalence and by bench setup)."""
+    from ..tables import kv as kvmod
+
+    vw = shards[0].sub.val.shape[1]
+    p1, nr, off = _layout(n_sub)
+    st = create(n_sub, vw, cf_buckets, cf_lock_slots, cf_slots=cf_slots,
+                **log_kw)
+    bank = np.zeros((S * nr, vw + 2), np.uint32)
+    cf = np.zeros((S * cf_buckets * cf_slots, 2 + vw), np.uint32)
+    for s, sh in enumerate(shards):
+        base = s * nr
+        for t_i, tbl in enumerate((sh.sub, sh.sec, sh.ai, sh.sf)):
+            n = tbl.val.shape[0]
+            rows = base + off[t_i] + np.arange(n)
+            bank[rows, :vw] = np.asarray(tbl.val)
+            bank[rows, vw] = np.asarray(tbl.ver)
+        d = kvmod.to_dict(sh.cf)
+        keys = np.array(sorted(d), np.uint64)
+        if len(keys):
+            # two-choice placement, same scheme the probe uses
+            bkt, slot = kvmod.assign_two_choice(keys, cf_buckets, cf_slots)
+            for key, b, sl_i in zip(keys, bkt, slot):
+                val, ver = d[int(key)]
+                row = (s * cf_buckets + int(b)) * cf_slots + int(sl_i)
+                cf[row, 0] = int(key) & 0xFFFFFFFF
+                cf[row, 1] = ver
+                cf[row, 2:] = val[:vw]
+    return st.replace(bank=jnp.asarray(bank), cf=jnp.asarray(cf))
+
+
+# ------------------------------------------------------------------ helpers
+
+
+def _segmeta(sort_key):
+    """head/rank/last/seg_id over equal sorted keys."""
+    r = sort_key.shape[0]
+    head = jnp.concatenate([jnp.ones((1,), bool),
+                            sort_key[1:] != sort_key[:-1]])
+    idx = jnp.arange(r, dtype=I32)
+    head_pos = jax.lax.cummax(jnp.where(head, idx, 0))
+    rank = idx - head_pos
+    last = jnp.concatenate([head[1:], jnp.ones((1,), bool)])
+    seg_id = jnp.cumsum(head.astype(I32)) - 1
+
+    def seg_sum(x):
+        return jax.ops.segment_sum(x, seg_id, num_segments=r)[seg_id]
+
+    def seg_max(x):
+        return jax.ops.segment_max(x, seg_id, num_segments=r)[seg_id]
+
+    def seg_min(x):
+        return jax.ops.segment_min(x, seg_id, num_segments=r)[seg_id]
+
+    return head_pos, rank, last, seg_sum, seg_max, seg_min
+
+
+def _unsort_packed(perm, *arrays):
+    """Return sorted-order arrays to lane order with ONE packed scatter."""
+    cols = [a[:, None] if a.ndim == 1 else a for a in arrays]
+    widths = [c.shape[1] for c in cols]
+    m = jnp.concatenate([c.astype(U32) for c in cols], axis=1)
+    out = jnp.zeros_like(m).at[perm].set(m)
+    res, s0 = [], 0
+    for a, wd in zip(arrays, widths):
+        piece = out[:, s0:s0 + wd]
+        res.append(piece[:, 0].astype(a.dtype) if a.ndim == 1
+                   else piece.astype(a.dtype))
+        s0 += wd
+    return res
+
+
+def _occ_dense(bank, sorted_rows, op, val_in, vw):
+    """Closed-form OCC pass over row-sorted lanes: ONE gather, ONE scatter.
+
+    Ops: OCC_READ / OCC_LOCK / COMMIT_PRIM / COMMIT_BCK / ABORT — the
+    semantics of tatp._dense_step on the flat bank. Returns
+    (bank', rtype, rver, rval) in SORTED order."""
+    head_pos, rank, last, seg_sum, seg_max, seg_min = _segmeta(sorted_rows)
+    r = op.shape[0]
+
+    rec = bank[sorted_rows]                 # [r, D] — THE gather
+    val0 = rec[:, :vw]
+    ver0 = rec[:, vw]
+    lock0 = rec[:, vw + 1] != 0
+
+    is_cp = op == Op.COMMIT_PRIM
+    is_commit = is_cp | (op == Op.COMMIT_BCK)
+    is_abort = op == Op.ABORT
+    is_read = op == Op.OCC_READ
+    is_lock = op == Op.OCC_LOCK
+
+    max_c = seg_max(jnp.where(is_commit, rank, I32(-1)))
+    any_c = max_c >= 0
+    pos_c = jnp.clip(head_pos + max_c, 0, r - 1)
+    n_c = seg_sum(is_commit.astype(I32))
+    val1 = jnp.where(any_c[:, None], val_in[pos_c], val0)
+    ver1 = jnp.where(any_c, ver0 + n_c.astype(U32), ver0)
+    unlock = seg_sum((is_cp | is_abort).astype(I32)) > 0
+    lock1 = lock0 & ~unlock
+
+    first_l = seg_min(jnp.where(is_lock, rank, I32(1 << 30)))
+    grant = is_lock & ~lock1 & (rank == first_l)
+    lock2 = lock1 | (seg_sum(grant.astype(I32)) > 0)
+
+    exists = ver1 > 0
+    rtype = jnp.full((r,), Reply.NONE, I32)
+    rtype = jnp.where(is_commit | is_abort, Reply.ACK, rtype)
+    rtype = jnp.where(is_read, jnp.where(exists, Reply.VAL, Reply.NOT_EXIST),
+                      rtype)
+    rtype = jnp.where(is_lock, jnp.where(grant, Reply.GRANT, Reply.REJECT),
+                      rtype)
+    rver = jnp.where(is_read & exists, ver1, U32(0))
+    rval = jnp.where((is_read & exists)[:, None], val1, jnp.zeros_like(val1))
+
+    writer = last & (seg_sum((op != Op.NOP).astype(I32)) > 0)
+    rec1 = jnp.concatenate(
+        [val1, ver1[:, None], lock2.astype(U32)[:, None]], axis=1)
+    safe = jnp.where(writer, sorted_rows, bank.shape[0])
+    bank = bank.at[safe].set(rec1, mode="drop")
+    return bank, rtype, rver, rval
+
+
+def _cf_pass(cf, cf_lock, nbc, nlc, shard, keys, op, val_in, active, vw):
+    """Compact CF sub-engine over window lanes sorted by (key, dest shard).
+
+    Ops: OCC_READ / OCC_LOCK / INSERT_* / DELETE_* / COMMIT_* / ABORT.
+    Lock ops hit cf_lock (hash-conflated OCC word); row ops hit the
+    single-hash SL-way table with exact per-bucket slot allocation.
+    Returns (cf', cf_lock', rtype, rver, rval) in window order."""
+    r = op.shape[0]
+    klo = keys.astype(U32)
+    zero = jnp.zeros_like(klo)
+    sl = cf.shape[0] // (S * nbc)
+    h1, h2 = hashing.bucket_pair(zero, klo, nbc)   # two-choice (kv.py layout)
+    b1 = shard * nbc + h1
+    b2 = shard * nbc + h2
+    lslot = shard * nlc + hashing.bucket(zero, klo, nlc)
+    # one segment per (key, dest): caller sorts by exactly that
+    segkey = jnp.where(active, (klo << U32(2)) | shard.astype(U32),
+                       U32(0xFFFFFFFF))
+    head_pos, rank, last, seg_sum, seg_max, seg_min = _segmeta(segkey)
+
+    recs = [cf[b1 * sl + s_i] for s_i in range(sl)] + \
+           [cf[b2 * sl + s_i] for s_i in range(sl)]   # 2*sl gathers [r, 2+vw]
+    kcol = jnp.stack([rc[:, 0] for rc in recs], 1)        # [r, 2*SL]
+    vercol = jnp.stack([rc[:, 1] for rc in recs], 1)
+    match = (kcol == klo[:, None]) & (vercol > 0) & active[:, None]
+    hit = match.any(1)
+    slot2 = jnp.argmax(match, 1).astype(I32)       # index into the 2*sl cols
+    free = vercol == 0
+    rec0 = jnp.take_along_axis(jnp.stack(recs, 1), slot2[:, None, None],
+                               1)[:, 0]
+    ver0 = jnp.where(hit, rec0[:, 1], U32(0))
+    val0 = rec0[:, 2:]
+
+    is_read = op == Op.OCC_READ
+    is_lockop = op == Op.OCC_LOCK
+    is_ins = (op == Op.INSERT_PRIM) | (op == Op.INSERT_BCK)
+    is_del = (op == Op.DELETE_PRIM) | (op == Op.DELETE_BCK)
+    is_com = (op == Op.COMMIT_PRIM) | (op == Op.COMMIT_BCK)
+    is_prim = ((op == Op.COMMIT_PRIM) | (op == Op.INSERT_PRIM)
+               | (op == Op.DELETE_PRIM))
+    is_abort = op == Op.ABORT
+    is_write = is_ins | is_del | is_com
+
+    # lock table: unlocks (prim/abort) first, then acquires in lane order
+    lk0 = cf_lock[lslot] != 0
+    unlocked = seg_sum((is_prim | is_abort).astype(I32)) > 0
+    lk1 = lk0 & ~unlocked
+    first_l = seg_min(jnp.where(is_lockop, rank, I32(1 << 30)))
+    grant = is_lockop & ~lk1 & (rank == first_l)
+    lk2 = lk1 | (seg_sum(grant.astype(I32)) > 0)
+    lwriter = last & active & (
+        seg_sum((is_lockop | is_prim | is_abort).astype(I32)) > 0)
+    cf_lock = cf_lock.at[jnp.where(lwriter, lslot, cf_lock.shape[0])].set(
+        lk2.astype(U32), mode="drop")
+
+    # row state: writes in lane order; last write decides existence/value
+    max_w = seg_max(jnp.where(is_write, rank, I32(-1)))
+    any_w = max_w >= 0
+    pos_w = jnp.clip(head_pos + max_w, 0, r - 1)
+    last_is_del = is_del[pos_w]
+    n_com = seg_sum(is_com.astype(I32))
+    n_ins = seg_sum(is_ins.astype(I32))
+    final_exists = jnp.where(any_w, ~last_is_del, hit)
+    ver1 = jnp.where(hit, ver0 + n_com.astype(U32),
+                     jnp.maximum(n_ins.astype(U32), U32(1)))
+    val1 = jnp.where(any_w[:, None], val_in[pos_w], val0)
+
+    # slot allocation for fresh installs: target = the emptier of the two
+    # candidate buckets (pre-batch occupancy), then rank per TARGET bucket,
+    # nth free slot; rank past the free count -> SPILL (counted; the
+    # reference's overflow instead chains in the userspace KVS)
+    need_alloc = last & any_w & final_exists & ~hit & active
+    free1 = free[:, :sl]
+    free2 = free[:, sl:]
+    use2 = free2.sum(1) > free1.sum(1)
+    tgt_bkt = jnp.where(use2, b2, b1)
+    tgt_free = jnp.where(use2[:, None], free2, free1)
+    order = jnp.arange(r, dtype=I32)
+    b_key, b_perm = jax.lax.sort(
+        (jnp.where(need_alloc, tgt_bkt.astype(U32), U32(0xFFFFFFFF)), order),
+        num_keys=2)
+    _, b_rank, _, _, _, _ = _segmeta(b_key)
+    alloc_rank = jnp.zeros((r,), I32).at[b_perm].set(b_rank)
+    cumfree = jnp.cumsum(tgt_free.astype(I32), axis=1)
+    want = tgt_free & (cumfree == (alloc_rank[:, None] + 1))
+    has_slot = want.any(1)
+    new_slot = jnp.argmax(want, 1).astype(I32)
+    spill_seg = seg_sum((need_alloc & ~has_slot).astype(I32)) > 0
+
+    writer = last & any_w & active & ~spill_seg & (hit | has_slot)
+    hit_row = jnp.where(slot2 < sl, b1 * sl + slot2, b2 * sl + (slot2 - sl))
+    row = jnp.where(hit, hit_row, tgt_bkt * sl + new_slot)
+    rec1 = jnp.concatenate(
+        [jnp.where(final_exists, klo, U32(0))[:, None],
+         jnp.where(final_exists, ver1, U32(0))[:, None], val1], axis=1)
+    cf = cf.at[jnp.where(writer, row, cf.shape[0])].set(rec1, mode="drop")
+
+    rtype = jnp.full((r,), Reply.NONE, I32)
+    rtype = jnp.where(is_read, jnp.where(hit, Reply.VAL, Reply.NOT_EXIST),
+                      rtype)
+    rtype = jnp.where(is_lockop,
+                      jnp.where(grant, Reply.GRANT, Reply.REJECT), rtype)
+    rtype = jnp.where(is_write | is_abort, Reply.ACK, rtype)
+    rtype = jnp.where(is_write & spill_seg, Reply.SPILL, rtype)
+    rver = jnp.where(is_read & hit, ver0, U32(0))
+    rval = jnp.where((is_read & hit)[:, None], val0, jnp.zeros_like(val0))
+    rtype = jnp.where(active, rtype, Reply.NONE)
+    return cf, cf_lock, rtype, rver, rval
+
+
+def _log_append(log, head, n_lanes: int, do, key, ver, val, table_id, is_del):
+    """Append write records to each replica's ring: S row-scatters."""
+    cap = log.shape[0] // (S * n_lanes)
+    r = do.shape[0]
+    idx = jnp.arange(r, dtype=I32)
+    lane_local = idx % n_lanes
+    one = do.astype(I32)
+    padr = (-r) % n_lanes
+    one_p = jnp.pad(one, (0, padr)).reshape(-1, n_lanes)
+    rank = (jnp.cumsum(one_p, axis=0) - one_p).reshape(-1)[:r]
+    counts = one_p.sum(axis=0).astype(U32)
+    flags = is_del.astype(U32) | (table_id.astype(U32) << U32(8))
+    entry = jnp.concatenate(
+        [flags[:, None], jnp.zeros((r, 1), U32), key.astype(U32)[:, None],
+         ver[:, None], val], axis=1)
+    nrow = log.shape[0]
+    for s in range(S):
+        lane = s * n_lanes + lane_local
+        pos = head[lane] + rank.astype(U32)
+        row = lane * cap + (pos % U32(cap)).astype(I32)
+        log = log.at[jnp.where(do, row, nrow)].set(entry, mode="drop")
+    return log, head + jnp.tile(counts, S)
+
+
+# ------------------------------------------------------------------ cohort
+
+
+def cohort_step(state: FusedState, key, *, w: int, n_sub: int,
+                cf_buckets: int, cf_lock_slots: int, log_lanes: int = 16,
+                validate: bool = True):
+    """One cohort of w txns against the fused 3-replica state.
+
+    Returns (state', stats [N_STATS] i32); stats layout is
+    tatp_pipeline's + STAT_OVERFLOW (cf window overflow -> lane REJECTs)."""
+    vw = state.val_words
+    p1, nr, off = _layout(n_sub)
+    kg, kv = jax.random.split(key)
+    ttype, ops, tbl, kk, ws = tp.gen_cohort(kg, w, n_sub)
+    ws_active, ws_lane, ws_tbl, ws_key, ws_kind = ws
+    r = w * K
+    r_cf = w  # wave-1/2 suffix window (E[cf lanes] ~ 0.18w)
+
+    lane_op = ops.reshape(r)
+    lane_tbl = tbl.reshape(r)
+    lane_key = kk.reshape(r)
+    used = lane_op != Op.NOP
+    owner = (lane_key % S).astype(I32)
+    is_cf = (lane_tbl == tatp.CALL_FORWARDING) & used
+
+    offs = jnp.asarray(off, I32)
+    dense_row = owner * nr + offs[jnp.clip(lane_tbl, 0, 3)] + lane_key
+    cf_code = (lane_key.astype(U32) << U32(2)) | owner.astype(U32)
+    sort_key = jnp.where(is_cf, BIG_CF + cf_code,
+                         jnp.where(used, dense_row.astype(U32), BIG_NOP))
+
+    order = jnp.arange(r, dtype=I32)
+    s_key, perm = jax.lax.sort((sort_key, order), num_keys=2)
+    s_op = lane_op[perm]
+    s_rows = jnp.where(s_key < BIG_NOP, s_key.astype(I32), I32(S * nr))
+
+    zval = jnp.zeros((r, vw), U32)
+    d_op = jnp.where(s_key < BIG_NOP, s_op, Op.NOP)
+    bank, d_rt, d_rv, d_rvl = _occ_dense(state.bank, s_rows, d_op, zval, vw)
+
+    # cf window = last r_cf sorted lanes
+    wd = slice(r - r_cf, r)
+    cf_active = s_key[wd] >= BIG_CF
+    cf_code_w = s_key[wd] - BIG_CF
+    cf_keys = cf_code_w >> U32(2)
+    cf_shard = (cf_code_w & U32(3)).astype(I32)
+    cf_op = jnp.where(cf_active, s_op[wd], Op.NOP)
+    cf, cf_lock, c_rt, c_rv, c_rvl = _cf_pass(
+        state.cf, state.cf_lock, cf_buckets, cf_lock_slots, cf_shard,
+        cf_keys, cf_op, zval[:r_cf], cf_active, vw)
+    overflow = s_key[: r - r_cf] >= BIG_CF
+    n_over = overflow.sum(dtype=I32)
+
+    rt_s = d_rt.at[wd].set(jnp.where(cf_active, c_rt, d_rt[wd]))
+    rt_s = jnp.where(jnp.concatenate([overflow, jnp.zeros((r_cf,), bool)]),
+                     Reply.REJECT, rt_s)
+    rv_s = d_rv.at[wd].set(jnp.where(cf_active, c_rv, d_rv[wd]))
+    rvl_s = d_rvl.at[wd].set(jnp.where(cf_active[:, None], c_rvl, d_rvl[wd]))
+    rt1f, rv1f, rvl1 = _unsort_packed(perm, rt_s, rv_s, rvl_s)
+    rt1 = rt1f.reshape(w, K)
+    rver1 = rv1f.reshape(w, K)
+
+    magic_bad = jnp.sum((rt1f == Reply.VAL) & (rvl1[:, 1] != MAGIC),
+                        dtype=I32)
+
+    # ---- outcome ----------------------------------------------------------
+    t = ttype
+    is_ro = ((t == wl.TATP_GET_SUBSCRIBER) | (t == wl.TATP_GET_ACCESS)
+             | (t == wl.TATP_GET_NEW_DEST))
+    rw = ~is_ro
+    ws_rt = jnp.take_along_axis(rt1, ws_lane, axis=1)
+    granted = ws_active & (ws_rt == Reply.GRANT)
+    lock_rejected = (ws_active & (ws_rt == Reply.REJECT)).any(axis=1)
+
+    missing = jnp.zeros((w,), bool)
+    m = t == wl.TATP_GET_NEW_DEST
+    missing |= m & (rt1[:, 0] != Reply.VAL)
+    m = (t == wl.TATP_UPDATE_SUBSCRIBER) | (t == wl.TATP_UPDATE_LOCATION)
+    missing |= m & ((rt1[:, 0] != Reply.VAL) | (rt1[:, 1] != Reply.VAL))
+    m = t == wl.TATP_INSERT_CF
+    missing |= m & ((rt1[:, 0] != Reply.VAL) | (rt1[:, 1] == Reply.VAL))
+    m = t == wl.TATP_DELETE_CF
+    missing |= m & (rt1[:, 0] != Reply.VAL)
+
+    ab_lock = rw & lock_rejected
+    ab_missing = rw & ~lock_rejected & missing
+    alive = rw & ~lock_rejected & ~missing
+
+    # ---- wave 2: validation re-read (parity ballast; re-gathers state) ----
+    if validate:
+        is_read_lane = (ops == Op.OCC_READ) & alive[:, None]
+        v_lane = jnp.where(is_read_lane.reshape(r), Op.OCC_READ, Op.NOP)
+        v_s = v_lane[perm]
+        vd_op = jnp.where(s_key < BIG_NOP, v_s, Op.NOP)
+        bank, v_rt, v_rv, _ = _occ_dense(bank, s_rows, vd_op, zval, vw)
+        cf, cf_lock, vc_rt, vc_rv, _ = _cf_pass(
+            cf, cf_lock, cf_buckets, cf_lock_slots, cf_shard, cf_keys,
+            jnp.where(cf_active, v_s[wd], Op.NOP), zval[:r_cf], cf_active,
+            vw)
+        v_rt = v_rt.at[wd].set(jnp.where(cf_active, vc_rt, v_rt[wd]))
+        v_rv = v_rv.at[wd].set(jnp.where(cf_active, vc_rv, v_rv[wd]))
+        vrtf, vrvf = _unsort_packed(perm, v_rt, v_rv)
+        vrt = vrtf.reshape(w, K)
+        vver = vrvf.reshape(w, K)
+        bad = is_read_lane & ((vver != rver1)
+                              | ((vrt != Reply.VAL) & (rt1 == Reply.VAL)))
+        changed = bad.any(axis=1)
+    else:
+        changed = jnp.zeros((w,), bool)
+    ab_validate = alive & changed
+    alive = alive & ~changed
+
+    state = state.replace(bank=bank, cf=cf, cf_lock=cf_lock)
+
+    # ---- wave 3: log x3 + one lane per (write slot, replica) --------------
+    do_w = ws_active & alive[:, None]                    # [w, 2]
+    w_owner = (ws_key % S).astype(I32)
+    payload = jax.random.randint(kv, (w, 2), 0, 1 << 16, dtype=I32)
+    newval = jnp.zeros((w, 2, vw), U32)
+    newval = newval.at[:, :, 0].set(payload.astype(U32))
+    newval = newval.at[:, :, 1].set(jnp.where(do_w, U32(MAGIC), U32(0)))
+
+    flat_do = jnp.concatenate([do_w[:, 0], do_w[:, 1]])
+    new_log, new_head = _log_append(
+        state.log, state.log_head, log_lanes, flat_do,
+        jnp.concatenate([ws_key[:, 0], ws_key[:, 1]]),
+        jnp.zeros((2 * w,), U32),
+        jnp.concatenate([newval[:, 0], newval[:, 1]]),
+        jnp.concatenate([ws_tbl[:, 0], ws_tbl[:, 1]]),
+        jnp.concatenate([ws_kind[:, 0] == 2, ws_kind[:, 1] == 2]))
+    state = state.replace(log=new_log, log_head=new_head)
+
+    prim_op = jnp.select([ws_kind == 1, ws_kind == 2],
+                         [Op.INSERT_PRIM, Op.DELETE_PRIM], Op.COMMIT_PRIM)
+    bck_op = jnp.select([ws_kind == 1, ws_kind == 2],
+                        [Op.INSERT_BCK, Op.DELETE_BCK], Op.COMMIT_BCK)
+    dead_abort = granted & ~alive[:, None]               # [w, 2]
+
+    parts = {"op": [], "key": [], "tbl": [], "val": [], "dest": []}
+    for sl_i in range(2):
+        for d_rel in range(S):
+            dest = (w_owner[:, sl_i] + d_rel) % S
+            if d_rel == 0:
+                o = jnp.where(do_w[:, sl_i], prim_op[:, sl_i],
+                              jnp.where(dead_abort[:, sl_i], Op.ABORT,
+                                        Op.NOP))
+            else:
+                o = jnp.where(do_w[:, sl_i], bck_op[:, sl_i], Op.NOP)
+            parts["op"].append(o)
+            parts["key"].append(ws_key[:, sl_i])
+            parts["tbl"].append(ws_tbl[:, sl_i])
+            parts["val"].append(newval[:, sl_i])
+            parts["dest"].append(dest)
+    c_op = jnp.concatenate(parts["op"])
+    c_key = jnp.concatenate(parts["key"])
+    c_tbl = jnp.concatenate(parts["tbl"])
+    c_val = jnp.concatenate(parts["val"])
+    c_dest = jnp.concatenate(parts["dest"])
+    rc = c_op.shape[0]                                   # 6w
+    c_used = c_op != Op.NOP
+    c_is_cf = (c_tbl == tatp.CALL_FORWARDING) & c_used
+    c_row = c_dest * nr + offs[jnp.clip(c_tbl, 0, 3)] + c_key
+    c_code = (c_key.astype(U32) << U32(2)) | c_dest.astype(U32)
+    c_sort = jnp.where(c_is_cf, BIG_CF + c_code,
+                       jnp.where(c_used, c_row.astype(U32), BIG_NOP))
+    order3 = jnp.arange(rc, dtype=I32)
+    s3_key, perm3 = jax.lax.sort((c_sort, order3), num_keys=2)
+    s3_op = c_op[perm3]
+    s3_val = c_val[perm3]
+    s3_rows = jnp.where(s3_key < BIG_NOP, s3_key.astype(I32), I32(S * nr))
+    d3_op = jnp.where(s3_key < BIG_NOP, s3_op, Op.NOP)
+    new_bank, _, _, _ = _occ_dense(state.bank, s3_rows, d3_op, s3_val, vw)
+
+    r3_cf = w // 2   # cf write lanes ~ 0.12w at the TATP mix
+    wd3 = slice(rc - r3_cf, rc)
+    cf3_active = s3_key[wd3] >= BIG_CF
+    over3 = (s3_key[: rc - r3_cf] >= BIG_CF).sum(dtype=I32)
+    cf3_code = s3_key[wd3] - BIG_CF
+    new_cf, new_cf_lock, _, _, _ = _cf_pass(
+        state.cf, state.cf_lock, cf_buckets, cf_lock_slots,
+        (cf3_code & U32(3)).astype(I32), cf3_code >> U32(2),
+        jnp.where(cf3_active, s3_op[wd3], Op.NOP), s3_val[wd3], cf3_active,
+        vw)
+    state = state.replace(bank=new_bank, cf=new_cf, cf_lock=new_cf_lock)
+
+    committed = (is_ro & ~missing) | alive
+    stats = jnp.stack([
+        jnp.asarray(w, I32), committed.sum(dtype=I32),
+        ab_lock.sum(dtype=I32),
+        (ab_missing | (is_ro & missing)).sum(dtype=I32),
+        ab_validate.sum(dtype=I32), magic_bad, n_over + over3,
+    ])
+    return state, stats
+
+
+def build_runner(n_sub: int, w: int = 8192, cf_buckets: int = 1 << 15,
+                 cf_lock_slots: int = 1 << 15, log_lanes: int = 16,
+                 cohorts_per_block: int = 8, validate: bool = True):
+    """jit(scan(cohort_step)); state donated, tables updated in place."""
+    step = functools.partial(cohort_step, w=w, n_sub=n_sub,
+                             cf_buckets=cf_buckets,
+                             cf_lock_slots=cf_lock_slots,
+                             log_lanes=log_lanes, validate=validate)
+
+    def block(state, key):
+        keys = jax.random.split(key, cohorts_per_block)
+        return jax.lax.scan(step, state, keys)
+
+    return jax.jit(block, donate_argnums=0)

@@ -71,6 +71,9 @@ class Reply:
     NOT_EXIST = 5      # bloom-negative / missing key
     VAL = 6            # read reply carrying value+version
     SPILL = 7          # bucket overflow: host must take over this key
+    REJECT_SAME_KEY = 8  # lock-attribution variant: holder has the SAME key
+                         # (true conflict, not hash sharing) — the reference's
+                         # REJECT_LOCK_SAME_KEY (tatp/ebpf/lock_kern.c:292-298)
 
 
 @flax.struct.dataclass

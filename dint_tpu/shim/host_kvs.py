@@ -1,0 +1,259 @@
+"""Host backing KVS + cached-store orchestrator (the userspace fallback).
+
+Plays the role of the reference's userspace KVS worker threads
+(store/ebpf/store_user.c:99-168: apply the evicted record piggybacked in the
+ext_message, then serve GET/SET/INSERT/DELETE against the real chained KVS)
+plus the bloom bookkeeping the kernel cannot do (DELETE-side bloom
+recompute happens in userspace, tatp/ebpf/shard_user.c DELETE path).
+
+`CachedStore` is the full two-tier server: device cache (engines.store_cache)
+in front, this host KVS behind, refills flowing back like the TC egress hook.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+
+from ..engines import store_cache
+from ..engines.types import Op, Reply, make_batch
+from ..ops import hashing
+
+
+class HostKVS:
+    """Authoritative backing store: dict of key -> (val tuple, ver), with
+    per-cache-bucket membership so bloom words stay exact."""
+
+    def __init__(self, cache_buckets: int, val_words: int):
+        self.data: dict[int, tuple[tuple, int]] = {}
+        self.nb = cache_buckets
+        self.vw = val_words
+        self._bucket_keys: dict[int, set] = {}   # cache bucket -> keys
+
+    def _bucket(self, key: int) -> int:
+        return int(hashing.bucket_np(np.uint64(key), self.nb))
+
+    def bloom_word(self, bucket: int) -> int:
+        word = 0
+        for k in self._bucket_keys.get(bucket, ()):
+            word |= 1 << int(hashing.bloom_bit_np(np.uint64(k)))
+        return word
+
+    def _track(self, key: int):
+        self._bucket_keys.setdefault(self._bucket(key), set()).add(key)
+
+    def _untrack(self, key: int):
+        self._bucket_keys.get(self._bucket(key), set()).discard(key)
+
+    def populate(self, keys, vals, vers=None):
+        vers = vers if vers is not None else np.ones(len(keys))
+        for k, v, ver in zip(keys, np.asarray(vals), vers):
+            self.data[int(k)] = (tuple(int(x) for x in v), int(ver))
+            self._track(int(k))
+
+    def writeback(self, key: int, val, ver: int):
+        """Apply an evicted dirty record (ext_message ver1==1 protocol)."""
+        self.data[key] = (tuple(int(x) for x in val), ver)
+        self._track(key)
+
+    def resolve_batch(self, ops, keys, vals):
+        """Serve the deferred lanes of one batch with the engine's
+        serialization contract (engines/store.py header): per key, GETs see
+        pre-batch state, then writes apply in lane order with monotonic
+        versions. Deferral is whole-segment, so every lane of a deferred key
+        is here — semantics compose exactly with the cache's local segments.
+
+        Returns (rtype [m], val [m, VW], ver [m])."""
+        m = len(ops)
+        rtype = np.zeros(m, np.int32)
+        rver = np.zeros(m, np.uint32)
+        rval = np.zeros((m, self.vw), np.uint32)
+        for i in range(m):
+            if ops[i] == Op.GET:
+                ent = self.data.get(int(keys[i]))
+                if ent is None:
+                    rtype[i] = Reply.NOT_EXIST
+                else:
+                    rtype[i] = Reply.VAL
+                    rval[i] = ent[0]
+                    rver[i] = ent[1]
+        base: dict[int, int] = {}
+        cnt: dict[int, int] = {}
+        for i in range(m):
+            k = int(keys[i])
+            if ops[i] in (Op.SET, Op.INSERT):
+                if k not in base:
+                    base[k] = self.data[k][1] if k in self.data else 0
+                    cnt[k] = 0
+                cnt[k] += 1
+                ver = base[k] + cnt[k]
+                self.data[k] = (tuple(int(x) for x in vals[i]), ver)
+                self._track(k)
+                rtype[i] = Reply.ACK
+                rver[i] = ver
+            elif ops[i] == Op.DELETE:
+                if k not in base:
+                    base[k] = self.data[k][1] if k in self.data else 0
+                    cnt[k] = 0
+                if k in self.data:
+                    del self.data[k]
+                    self._untrack(k)
+                    rtype[i] = Reply.ACK
+                else:
+                    rtype[i] = Reply.NOT_EXIST
+        return rtype, rval, rver
+
+
+@dataclasses.dataclass
+class CacheStats:
+    served: int = 0
+    hits: int = 0          # lanes answered by the device cache
+    misses: int = 0        # lanes deferred to the host
+    bloom_negatives: int = 0
+    writebacks: int = 0    # evicted dirty records applied
+
+
+class CachedStore:
+    """Two-tier store server: device cache + host KVS + refill loop."""
+
+    def __init__(self, cache_buckets: int, val_words: int = 10,
+                 slots: int = 4, policy: str = store_cache.WB_BLOOM,
+                 width: int = 4096):
+        self.cache = store_cache.create(cache_buckets, slots, val_words)
+        self.kvs = HostKVS(cache_buckets, val_words)
+        self.policy = policy
+        self.vw = val_words
+        self.width = width
+        self.stats = CacheStats()
+        self._step = jax.jit(
+            lambda c, b: store_cache.cache_step(c, b, policy=policy),
+            donate_argnums=0)
+        self._refill = jax.jit(store_cache.refill, donate_argnums=0)
+        self._pending: dict[int, bool] = {}    # refill keys (bloom-only if False)
+
+    def populate(self, keys, vals, vers=None):
+        """Load the backing store AND prime the device bloom words — the
+        reference's equivalent state arises from populate-over-network, where
+        every install travels the TC path and sets its bloom bit
+        (store/ebpf/store_kern.c:302-372). A zeroed bloom would wrongly
+        short-circuit GETs for populated-but-uncached keys to NOT_EXIST."""
+        import jax.numpy as jnp
+
+        self.kvs.populate(keys, vals, vers)
+        keys = np.asarray(keys, np.uint64)
+        nb = self.cache.kv.n_buckets
+        bkt = hashing.bucket_np(keys, nb)
+        bits = hashing.bloom_bit_np(keys)
+        bloom = np.zeros(nb, np.uint64)
+        np.bitwise_or.at(bloom, bkt, np.uint64(1) << bits.astype(np.uint64))
+        t = self.cache.kv
+        self.cache = self.cache.replace(kv=t.replace(
+            bloom_hi=jnp.asarray((bloom >> np.uint64(32)).astype(np.uint32)),
+            bloom_lo=jnp.asarray(bloom.astype(np.uint32))))
+
+    def serve(self, ops, keys, vals=None):
+        """One server round: refill -> device step -> host fallback.
+
+        Returns (rtype [n], val [n, VW], ver [n]) numpy arrays.
+        """
+        n = len(ops)
+        ops = np.asarray(ops, np.int32)
+        keys = np.asarray(keys, np.uint64)
+        if vals is None:
+            vals = np.zeros((n, self.vw), np.uint32)
+
+        self._do_refills()
+        batch = make_batch(ops, keys, vals, width=self.width,
+                           val_words=self.vw)
+        self.cache, replies, miss, flush = self._step(self.cache, batch)
+        rtype = np.asarray(replies.rtype)[:n].copy()
+        rval = np.asarray(replies.val)[:n].copy()
+        rver = np.asarray(replies.ver)[:n].copy()
+        miss = np.asarray(miss)[:n]
+
+        # dirty cached copies of deferred segments MUST land in the backing
+        # store before their lanes are resolved (see cache_step docstring)
+        f_mask = np.asarray(flush["mask"])
+        if f_mask.any():
+            fkh = np.asarray(flush["key_hi"])[f_mask]
+            fkl = np.asarray(flush["key_lo"])[f_mask]
+            fv = np.asarray(flush["val"])[f_mask]
+            fr = np.asarray(flush["ver"])[f_mask]
+            for kh, kl, v, vr in zip(fkh, fkl, fv, fr):
+                self.kvs.writeback((int(kh) << 32) | int(kl), v, int(vr))
+                self.stats.writebacks += 1
+
+        st = self.stats
+        st.served += n
+        st.misses += int(miss.sum())
+        st.hits += int((~miss & (ops != Op.NOP)).sum())
+        st.bloom_negatives += int((rtype[~miss] == Reply.NOT_EXIST).sum())
+
+        # host fallback: resolve the deferred lanes as one sub-batch
+        mi = np.nonzero(miss)[0]
+        if len(mi):
+            rt, rv, rr = self.kvs.resolve_batch(ops[mi], keys[mi],
+                                                np.asarray(vals)[mi])
+            rtype[mi], rver[mi] = rt, rr
+            rval[mi] = rv
+            # queue refills: full record for present keys, bloom-only after
+            # DELETE / for absent keys (keeps negatives exact)
+            for k in keys[mi]:
+                self._pending[int(k)] = int(k) in self.kvs.data
+        return rtype, rval, rver
+
+    def _do_refills(self):
+        if not self._pending:
+            return
+        items = list(self._pending.items())[: self.width]
+        for k, _ in items:
+            del self._pending[k]
+        r = len(items)
+        key = np.array([k for k, _ in items], np.uint64)
+        val = np.zeros((r, self.vw), np.uint32)
+        ver = np.zeros(r, np.uint32)
+        bloom = np.zeros(r, np.uint64)
+        for j, (k, present) in enumerate(items):
+            if present:
+                ent = self.kvs.data[k]
+                val[j] = ent[0]
+                ver[j] = ent[1]
+            bloom[j] = self.kvs.bloom_word(self.kvs._bucket(k))
+        # dedup per bucket: refill installs at most one record per bucket per
+        # call; re-queue the rest
+        bkt = hashing.bucket_np(key, self.cache.kv.n_buckets)
+        seen, keep = set(), []
+        for j in range(r):
+            if int(bkt[j]) in seen:
+                self._pending[int(key[j])] = items[j][1]
+            else:
+                seen.add(int(bkt[j]))
+                keep.append(j)
+        keep = np.array(keep, np.int64)
+        key, val, ver, bloom = key[keep], val[keep], ver[keep], bloom[keep]
+        r = len(keep)
+
+        pad = self.width - r
+        key_hi = (key >> np.uint64(32)).astype(np.uint32)
+        key_lo = key.astype(np.uint32)
+        b_hi = (bloom >> np.uint64(32)).astype(np.uint32)
+        b_lo = bloom.astype(np.uint32)
+
+        def p(x, fill=0):
+            return np.concatenate([x, np.full((pad,) + x.shape[1:], fill,
+                                              x.dtype)])
+
+        mask = p(np.ones(r, bool), False)
+        self.cache, ev = self._refill(
+            self.cache, p(key_hi), p(key_lo), p(val), p(ver),
+            p(b_hi), p(b_lo), mask)
+        ev_mask = np.asarray(ev["mask"])
+        if ev_mask.any():
+            ekh = np.asarray(ev["key_hi"])[ev_mask]
+            ekl = np.asarray(ev["key_lo"])[ev_mask]
+            evv = np.asarray(ev["val"])[ev_mask]
+            evr = np.asarray(ev["ver"])[ev_mask]
+            for kh, kl, v, vr in zip(ekh, ekl, evv, evr):
+                self.kvs.writeback((int(kh) << 32) | int(kl), v, int(vr))
+                self.stats.writebacks += 1

@@ -46,7 +46,7 @@ class Profile:
 
 
 def _profile(name, fmt, req: dict, rep: dict) -> Profile:
-    n_rep = 8  # Reply codes 0..7 (engines.types.Reply)
+    n_rep = 16  # headroom over engines.types.Reply codes (currently 0..8)
     req_map = np.full(_N_WIRE, Op.NOP, np.int32)
     for wcode, op in req.items():
         req_map[wcode] = op
@@ -89,7 +89,10 @@ LOCK2PL = _Lock2PLProfile("lock_2pl", FMT_LOCK6, _LOCK2PL_BASE.req_map,
 FASST = _profile("lock_fasst", FMT_FASST9,
                  {0: Op.READ_VER, 1: Op.LOCK, 2: Op.ABORT, 3: Op.COMMIT_VER},
                  {0: {Reply.VAL: 4},
-                  1: {Reply.GRANT: 5, Reply.REJECT: 6},
+                  # lock_fasst's wire enum has no same-key code; the
+                  # attribution variant degrades to plain REJECT_LOCK here
+                  1: {Reply.GRANT: 5, Reply.REJECT: 6,
+                      Reply.REJECT_SAME_KEY: 6},
                   2: {Reply.ACK: 7},
                   3: {Reply.ACK: 8, Reply.REJECT: 6}})
 
@@ -123,7 +126,8 @@ TATP = _profile("tatp", FMT_MSG55,
                  18: Op.INSERT_PRIM, 19: Op.INSERT_BCK,
                  22: Op.DELETE_PRIM, 23: Op.DELETE_BCK, 24: Op.DELETE_LOG},
                 {0: {Reply.VAL: 4, Reply.REJECT: 5, Reply.NOT_EXIST: 6},
-                 1: {Reply.GRANT: 7, Reply.REJECT: 8},
+                 1: {Reply.GRANT: 7, Reply.REJECT: 8,
+                     Reply.REJECT_SAME_KEY: 28},
                  2: {Reply.ACK: 9},
                  12: {Reply.ACK: 15, Reply.REJECT: 11},
                  13: {Reply.ACK: 16, Reply.REJECT: 11},

@@ -58,6 +58,29 @@ def create_occ(n_slots: int) -> OCCTable:
                     ver=jnp.zeros((n_slots,), U32))
 
 
+@flax.struct.dataclass
+class OCCAttrTable:
+    """OCC lock word + the HOLDER'S KEY, so rejects can distinguish a true
+    same-key conflict from hash-slot sharing — the reference's
+    `struct txn_lock {lock_bit, key}` (tatp/ebpf/lock_kern.c:12-16)."""
+    locked: jax.Array    # bool [NL]
+    ver: jax.Array       # u32 [NL]
+    owner_hi: jax.Array  # u32 [NL]
+    owner_lo: jax.Array  # u32 [NL]
+
+    @property
+    def n_slots(self):
+        return self.locked.shape[0]
+
+
+def create_occ_attr(n_slots: int) -> OCCAttrTable:
+    assert n_slots & (n_slots - 1) == 0
+    return OCCAttrTable(locked=jnp.zeros((n_slots,), bool),
+                        ver=jnp.zeros((n_slots,), U32),
+                        owner_hi=jnp.zeros((n_slots,), U32),
+                        owner_lo=jnp.zeros((n_slots,), U32))
+
+
 def lock_slot(key_hi, key_lo, n_slots: int):
     """key -> lock-table slot (hash-sharded, collisions conflate)."""
     return hashing.bucket(key_hi, key_lo, n_slots)

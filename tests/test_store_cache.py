@@ -1,0 +1,100 @@
+"""Cache-mode store (device cache + host KVS) vs the sequential oracle.
+
+The two-tier server (engines/store_cache + shim/host_kvs) must be reply-
+equivalent to the flat sequential oracle for every policy — the cache, the
+miss/refill protocol, evictions, and dirty write-backs are pure
+implementation detail (exactly the reference's claim for its kernel cache,
+SURVEY.md §4.2 cross-backend equivalence)."""
+import numpy as np
+import pytest
+
+from dint_tpu.engines import store_cache
+from dint_tpu.engines.types import Op, Reply
+from dint_tpu.shim.host_kvs import CachedStore
+from dint_tpu.testing.oracle import StoreOracle
+
+VW = 4
+
+
+def _run_diff(policy, rng, rounds=12, n=96, keyspace=60, cache_buckets=8):
+    """Tiny cache (8 buckets x 4 slots = 32 slots) over a 60-key space:
+    plenty of misses, evictions, and bucket pressure."""
+    srv = CachedStore(cache_buckets, val_words=VW, policy=policy, width=128)
+    oracle = StoreOracle()
+
+    keys0 = np.arange(1, keyspace // 2, dtype=np.uint64)
+    vals0 = rng.integers(1, 99, size=(len(keys0), VW)).astype(np.uint32)
+    srv.populate(keys0, vals0)
+    oracle.step(np.full(len(keys0), Op.INSERT, np.int32), keys0, vals0)
+
+    for _ in range(rounds):
+        ops = rng.choice([Op.GET, Op.GET, Op.GET, Op.SET, Op.SET, Op.INSERT,
+                          Op.DELETE], size=n).astype(np.int32)
+        keys = rng.integers(1, keyspace, size=n).astype(np.uint64)
+        vals = rng.integers(1, 99, size=(n, VW)).astype(np.uint32)
+        rt, rv, rr = srv.serve(ops, keys, vals)
+        ort, orv, orr = oracle.step(ops, keys, vals)
+        # oracle INSERT replies ACK with ver, ours too; compare everything
+        np.testing.assert_array_equal(rt, ort, err_msg=f"rtype {policy}")
+        np.testing.assert_array_equal(rr, orr, err_msg=f"ver {policy}")
+        isval = ort == Reply.VAL
+        np.testing.assert_array_equal(rv[isval], orv[isval],
+                                      err_msg=f"val {policy}")
+    return srv
+
+
+@pytest.mark.parametrize("policy", store_cache.POLICIES)
+def test_policy_matches_oracle(policy, rng):
+    srv = _run_diff(policy, rng)
+    st = srv.stats
+    assert st.misses > 0, "workload never exercised the miss path"
+    assert st.hits > 0, "workload never hit the cache"
+
+
+def test_writeback_evictions_flush_dirty(rng):
+    """Write-back under heavy pressure must produce evictions whose dirty
+    records land in the backing store (ext_message ver1==1 protocol)."""
+    srv = _run_diff(store_cache.WB_BLOOM, rng, rounds=20, keyspace=120,
+                    cache_buckets=4)
+    assert srv.stats.writebacks > 0
+
+
+def test_bloom_negative_short_circuit(rng):
+    """WB_BLOOM answers GETs for absent keys on-device (NOT_EXIST without a
+    host trip); WB_NOBLOOM pays a miss for the same workload."""
+    def count_miss(policy):
+        srv = CachedStore(8, val_words=VW, policy=policy, width=64)
+        srv.populate(np.array([1, 2], np.uint64),
+                     np.ones((2, VW), np.uint32))
+        ops = np.full(32, Op.GET, np.int32)
+        keys = np.arange(100, 132, dtype=np.uint64)   # all absent
+        rt, _, _ = srv.serve(ops, keys)
+        assert (rt == Reply.NOT_EXIST).all()
+        return srv.stats.misses
+
+    assert count_miss(store_cache.WB_BLOOM) == 0
+    assert count_miss(store_cache.WB_NOBLOOM) == 32
+
+
+def test_write_through_set_invalidates(rng):
+    """WT: SET defers to host and drops the cached copy; the next GET
+    re-misses and refills (store_wt_kern.c:115-151 semantics)."""
+    srv = CachedStore(8, val_words=VW, policy=store_cache.WT, width=64)
+    srv.populate(np.array([5], np.uint64), np.full((1, VW), 7, np.uint32))
+    # GET warms the cache
+    rt, _, _ = srv.serve(np.array([Op.GET], np.int32), np.array([5], np.uint64))
+    m0 = srv.stats.misses
+    # second GET: refilled -> cache hit
+    srv.serve(np.array([Op.GET], np.int32), np.array([5], np.uint64))
+    assert srv.stats.misses == m0
+    # SET invalidates + defers
+    srv.serve(np.array([Op.SET], np.int32), np.array([5], np.uint64),
+              np.full((1, VW), 9, np.uint32))
+    assert srv.stats.misses == m0 + 1
+    # GET after SET: the refill queued by the SET lands at the start of the
+    # next round (the TC hook installing the fetched record), so this HITS
+    # with the new value — no second miss
+    rt, rv, rr = srv.serve(np.array([Op.GET], np.int32),
+                           np.array([5], np.uint64))
+    assert rt[0] == Reply.VAL and rv[0, 0] == 9 and rr[0] == 2
+    assert srv.stats.misses == m0 + 1
