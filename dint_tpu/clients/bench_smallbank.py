@@ -1,0 +1,52 @@
+"""SmallBank bench window: committed txn/s on the device-fused pipeline.
+
+Reference-scale parameters (BASELINE.md): 24M accounts x {SAVINGS, CHECKING},
+90% of txns on the 4% hot set, mix 15/15/15/25/15/15, 3 replicated shards
+with the log x3 / bck x2 / prim commit pipeline
+(smallbank/caladan/client_ebpf_shard.cc:389-560). Called from bench.py's
+child process; returns extra JSON fields for the headline line.
+
+The balance-conservation invariant is checked over the whole window:
+table-sum delta (mod 2^32) must equal the pipeline's own committed-delta
+accounting. A violation raises — a corrupted window must not report a number.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from .. import stats
+from ..engines import smallbank_pipeline as sp
+
+N_ACCOUNTS = 24_000_000
+WIDTH = 8192
+BLOCK = 16
+
+
+def run(window_s: float = 10.0, n_accounts: int = N_ACCOUNTS,
+        width: int = WIDTH, block: int = BLOCK) -> dict:
+    stacked = sp.create_stacked(n_accounts)
+    base = int(np.asarray(sp.total_balance(stacked)))
+    runner = sp.build_runner(n_accounts, w=width, cohorts_per_block=block)
+    key = jax.random.PRNGKey(1)
+
+    stacked, total, warm, dt, _ = stats.run_window(
+        runner, stacked, key, window_s, sp.N_STATS, warmup_blocks=1)
+
+    committed = int(total[sp.STAT_COMMITTED])
+    attempted = int(total[sp.STAT_ATTEMPTED])
+    if int(total[sp.STAT_MAGIC_BAD] + warm[sp.STAT_MAGIC_BAD]) != 0:
+        raise RuntimeError("smallbank magic-byte integrity violated")
+    # conservation covers the WHOLE run (warmup writes land in the tables too)
+    accounted = int(total[sp.STAT_BAL_DELTA] + warm[sp.STAT_BAL_DELTA])
+    final = int(np.asarray(sp.total_balance(stacked)))
+    if (final - base) % (1 << 32) != accounted % (1 << 32):
+        raise RuntimeError(
+            f"balance conservation violated: table delta {final - base} != "
+            f"accounted {accounted} (mod 2^32)")
+
+    return {
+        "smallbank_committed_txns_per_sec": round(committed / dt, 1),
+        "smallbank_abort_rate": round(1 - committed / max(attempted, 1), 5),
+        "smallbank_balance_conserved": True,
+    }

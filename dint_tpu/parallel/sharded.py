@@ -85,16 +85,21 @@ def replicated_step(shard: tatp.Shard, batch: Batch, *, n_shards: int):
     """One multi-chip TATP step, called inside shard_map.
 
     `batch` holds this device's primary-routed requests with GLOBAL keys.
-    Applies the primary step locally, ppermutes commit records to the two
-    backup neighbors, applies received backups, and psums the commit vote.
+    Builds one combined batch of [3w] lanes — primary lanes (role 0) plus
+    the commit records ppermuted in from the two devices we back up
+    (roles 1, 2) — and applies tatp.step ONCE. Safe to fuse because the
+    three role views touch disjoint state: dense rows are disjoint by the
+    role remap, and backup CF keys are owned by other devices (owner =
+    key % n), so no (table, key) group spans roles. Psums the commit vote.
     Returns (shard', replies, global_committed).
-    """
-    shard, replies = tatp.step(shard, _remap_dense_keys(batch, n_shards, 0))
 
-    # forward this device's prim-commit records to backups d+1, d+2
+    A single step instead of three keeps compile time ~1/3 of the unrolled
+    form (the whole 5-table engine is traced once, not per role).
+    """
     is_prim = ((batch.op == Op.COMMIT_PRIM) | (batch.op == Op.INSERT_PRIM)
                | (batch.op == Op.DELETE_PRIM))
     bck_op = _as_backup_ops(batch.op)
+    parts = [_remap_dense_keys(batch, n_shards, 0)]
     for off in (1, 2):
         perm = [(i, (i + off) % n_shards) for i in range(n_shards)]
         pp = functools.partial(jax.lax.ppermute, axis_name=SHARD_AXIS, perm=perm)
@@ -102,7 +107,11 @@ def replicated_step(shard: tatp.Shard, batch: Batch, *, n_shards: int):
                     key_hi=pp(batch.key_hi), key_lo=pp(batch.key_lo),
                     val=pp(batch.val), ver=pp(batch.ver))
         # received records came from the device `off` behind us -> role `off`
-        shard, _ = tatp.step(shard, _remap_dense_keys(fwd, n_shards, off))
+        parts.append(_remap_dense_keys(fwd, n_shards, off))
+
+    combined = jax.tree.map(lambda *xs: jnp.concatenate(xs, axis=0), *parts)
+    shard, rep = tatp.step(shard, combined)
+    replies = jax.tree.map(lambda x: x[: batch.width], rep)
 
     committed = jax.lax.psum(is_prim.sum().astype(I32), SHARD_AXIS)
     return shard, replies, committed

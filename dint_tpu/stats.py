@@ -116,6 +116,45 @@ class LatencyReservoir:
                     p999=float(p999))
 
 
+def run_window(runner, state, key, window_s: float, n_stats: int,
+               warmup_blocks: int = 1):
+    """Timed measurement loop shared by the device-fused pipeline benches.
+
+    Runs `warmup_blocks` dispatches (compile + cache warm), then dispatches
+    until `window_s` elapses, overlapping the host-side stats reduction of
+    block i-1 with device execution of block i. Syncs by VALUE FETCH
+    (np.asarray), never jax.block_until_ready — the axon platform returns
+    from block_until_ready while the device is still executing, so a fetch
+    is the only honest window bracket.
+
+    Returns (state, total [n_stats] i64, warm_total [n_stats] i64,
+    elapsed_s, blocks): `total` covers only the timed window; `warm_total`
+    covers warmup (callers with table-vs-accounting invariants need it —
+    warmup writes land in the tables too).
+    """
+    import jax
+
+    warm_total = np.zeros(n_stats, np.int64)
+    for i in range(warmup_blocks):
+        state, stats = runner(state, jax.random.fold_in(key, i))
+        warm_total += np.asarray(stats, np.int64).sum(axis=0)
+
+    total = np.zeros(n_stats, np.int64)
+    t0 = time.time()
+    i = warmup_blocks
+    pending = None
+    while time.time() - t0 < window_s:
+        state, stats = runner(state, jax.random.fold_in(key, i))
+        if pending is not None:
+            total += np.asarray(pending, np.int64).sum(axis=0)
+        pending = stats
+        i += 1
+    if pending is not None:
+        total += np.asarray(pending, np.int64).sum(axis=0)  # fetch = sync
+    dt = time.time() - t0
+    return state, total, warm_total, dt, i - warmup_blocks
+
+
 @dataclasses.dataclass
 class TxnStats:
     """Base attempted/committed accounting shared by all txn coordinators
