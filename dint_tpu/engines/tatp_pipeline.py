@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import functools
 
+import flax.struct
 import jax
 import jax.numpy as jnp
 
@@ -84,13 +85,15 @@ def _merge(owner, stacked):
     return stacked[owner, jnp.arange(r)]
 
 
-def gen_cohort(key, w: int, n_sub: int):
+def gen_cohort(key, w: int, n_sub: int, mix=None):
     """On-device workload generation (tatp/caladan/tatp.h:40-63).
 
     Returns (ttype [w], lane ops/tbl/keys [w, K], write-slot arrays [w, 2]).
     """
     k1, k2, k3, k4 = jax.random.split(key, 4)
-    ttype = jax.random.choice(k1, 7, shape=(w,), p=jnp.asarray(wl.TATP_MIX))
+    ttype = jax.random.choice(
+        k1, 7, shape=(w,),
+        p=jnp.asarray(wl.TATP_MIX if mix is None else mix))
     # NURand: ((x | y) % n) + 1
     x = jax.random.randint(k2, (w,), 0, wl.TATP_A + 1, dtype=I32)
     y = jax.random.randint(k3, (w,), 1, n_sub + 1, dtype=I32)
@@ -297,6 +300,285 @@ def cohort_step(stacked: tatp.Shard, key, *, w: int, n_sub: int,
         magic_bad,
     ])
     return stacked, stats
+
+
+# --------------------------------------------------------------------------
+# Cross-cohort software pipeline: REAL concurrency between transactions.
+#
+# The serialized cohort_step above runs read+lock -> validate -> commit to
+# completion per cohort, so no commit can ever land between a txn's read and
+# its validation (ab_validate is structurally 0 — the honest caveat in its
+# docstring). This pipeline overlaps cohort lifetimes exactly like the
+# reference's thousands of concurrently in-flight client txns
+# (tatp/caladan/client_ebpf_shard.cc:1589-1613): device step t executes, in
+# ONE combined batch,
+#
+#   wave 1 of cohort t     (read + lock at owners)
+#   wave 2 of cohort t-1   (validate re-reads)
+#   wave 3 of cohort t-2   (log x3 / prim / bck / abort)
+#
+# The engine's per-row phase order (commits install and release BEFORE
+# reads, lock acquires LAST — engines/tatp._dense_step) gives the reference
+# interleaving: cohort t-2's commits are visible to cohort t-1's validation
+# re-reads, so a version bumped between read (step t-1) and validate
+# (step t) aborts the txn — ab_validate is live and responds to contention.
+# Locks held by in-flight cohorts likewise reject younger cohorts' lock
+# attempts (no-wait, first-wins), raising ab_lock under skew. Validation is
+# version-compare only, exactly the reference's verify stage
+# (client_ebpf_shard.cc:765-768) — reads do not check row locks.
+# --------------------------------------------------------------------------
+
+
+@flax.struct.dataclass
+class PipeCtx:
+    """An in-flight cohort between pipeline stages (all [w]-shaped unless
+    noted). Bootstrap cohorts have attempted == 0 and all-False masks, so
+    they contribute NOP lanes and zero stats."""
+    ops: jax.Array        # i32 [w, K] wave-1 lane ops
+    tbl: jax.Array        # i32 [w, K]
+    kk: jax.Array         # i32 [w, K] lane keys
+    rver1: jax.Array      # u32 [w, K] versions read at wave 1
+    rt1_val: jax.Array    # bool [w, K] lane replied VAL at wave 1
+    granted: jax.Array    # bool [w, 2] write-slot locks granted
+    alive: jax.Array      # bool [w] still commit-eligible
+    ro_commit: jax.Array  # bool [w] read-only txn that succeeded at wave 1
+    ws_active: jax.Array  # bool [w, 2]
+    ws_tbl: jax.Array     # i32 [w, 2]
+    ws_key: jax.Array     # i32 [w, 2]
+    ws_kind: jax.Array    # i32 [w, 2] 0 commit / 1 insert / 2 delete
+    attempted: jax.Array  # i32 scalar (w, or 0 for bootstrap)
+    ab_lock: jax.Array    # i32 scalar
+    ab_missing: jax.Array # i32 scalar
+    ab_validate: jax.Array  # i32 scalar (set by the validate stage)
+    magic_bad: jax.Array  # i32 scalar
+
+
+def empty_ctx(w: int) -> PipeCtx:
+    # every field materializes its OWN device buffer (via a fresh numpy
+    # array): the runner donates the whole carry, and XLA rejects donating
+    # an aliased buffer twice
+    import numpy as np
+
+    def z(shape, dt):
+        return jnp.asarray(np.zeros(shape, dt))
+
+    return PipeCtx(
+        ops=z((w, K), np.int32), tbl=z((w, K), np.int32),
+        kk=z((w, K), np.int32), rver1=z((w, K), np.uint32),
+        rt1_val=z((w, K), bool), granted=z((w, 2), bool),
+        alive=z((w,), bool), ro_commit=z((w,), bool),
+        ws_active=z((w, 2), bool), ws_tbl=z((w, 2), np.int32),
+        ws_key=z((w, 2), np.int32), ws_kind=z((w, 2), np.int32),
+        attempted=z((), np.int32), ab_lock=z((), np.int32),
+        ab_missing=z((), np.int32), ab_validate=z((), np.int32),
+        magic_bad=z((), np.int32))
+
+
+def _wave1_lanes(ops, tbl, kk):
+    """Flat wave-1 lane arrays + owner routing ([r] each, r = w*K)."""
+    r = ops.shape[0] * K
+    lane_op = ops.reshape(r)
+    lane_tbl = tbl.reshape(r)
+    used = lane_op != Op.NOP
+    lane_key = jnp.where(used, kk.reshape(r).astype(U32),
+                         U32(PAD_KEY & 0xFFFFFFFF))
+    owner = (kk.reshape(r) % N_SHARDS).astype(I32)
+    return lane_op, lane_tbl, lane_key, owner, used
+
+
+def _validate_lanes(ctx: PipeCtx):
+    """Wave-2 lane arrays for an in-flight cohort: re-read the read-set of
+    surviving RW txns (and of nothing else)."""
+    w = ctx.alive.shape[0]
+    r = w * K
+    is_read_lane = (ctx.ops == Op.OCC_READ) & ctx.alive[:, None]
+    v_op = jnp.where(is_read_lane.reshape(r), Op.OCC_READ, Op.NOP)
+    v_used = v_op != Op.NOP
+    v_key = jnp.where(v_used, ctx.kk.reshape(r).astype(U32),
+                      U32(PAD_KEY & 0xFFFFFFFF))
+    owner = (ctx.kk.reshape(r) % N_SHARDS).astype(I32)
+    return v_op, ctx.tbl.reshape(r), v_key, owner, v_used, is_read_lane
+
+
+def _wave3_lanes(ctx: PipeCtx, kval, val_words: int):
+    """Wave-3 lane arrays for a validated cohort (4w lanes: log ws0 | log
+    ws1 | role ws0 | role ws1), identical to the serialized wave 3."""
+    w = ctx.alive.shape[0]
+    sid = jnp.arange(N_SHARDS, dtype=I32)
+    w_owner = (ctx.ws_key % N_SHARDS).astype(I32)
+    do_write = ctx.ws_active & ctx.alive[:, None]
+    newval = jnp.zeros((w, 2, val_words), U32)
+    payload = jax.random.randint(kval, (w, 2), 0, 1 << 16, dtype=I32)
+    newval = newval.at[:, :, 0].set(payload.astype(U32))
+    newval = newval.at[:, :, 1].set(jnp.where(do_write, U32(MAGIC), U32(0)))
+
+    log_op = jnp.where(do_write,
+                       jnp.where(ctx.ws_kind == 2, Op.DELETE_LOG,
+                                 Op.COMMIT_LOG), Op.NOP)
+    prim_op = jnp.select([ctx.ws_kind == 1, ctx.ws_kind == 2],
+                         [Op.INSERT_PRIM, Op.DELETE_PRIM], Op.COMMIT_PRIM)
+    bck_op = jnp.select([ctx.ws_kind == 1, ctx.ws_kind == 2],
+                        [Op.INSERT_BCK, Op.DELETE_BCK], Op.COMMIT_BCK)
+    dead_abort = ctx.granted & ~ctx.alive[:, None]
+    role_s = jnp.where(
+        do_write[None], jnp.where(w_owner[None] == sid[:, None, None],
+                                  prim_op[None], bck_op[None]),
+        jnp.where(dead_abort[None] & (w_owner[None] == sid[:, None, None]),
+                  Op.ABORT, Op.NOP))                       # [S, w, 2]
+
+    c_used = do_write | dead_abort
+    c_key = jnp.where(c_used, ctx.ws_key.astype(U32),
+                      U32(PAD_KEY & 0xFFFFFFFF))
+    lane_key = jnp.concatenate([c_key[:, 0], c_key[:, 1],
+                                c_key[:, 0], c_key[:, 1]])
+    lane_tbl = jnp.concatenate([ctx.ws_tbl[:, 0], ctx.ws_tbl[:, 1],
+                                ctx.ws_tbl[:, 0], ctx.ws_tbl[:, 1]])
+    lane_val = jnp.concatenate([newval[:, 0], newval[:, 1],
+                                newval[:, 0], newval[:, 1]])
+    op_s = jnp.concatenate([
+        jnp.broadcast_to(log_op[:, 0][None], (N_SHARDS, w)),
+        jnp.broadcast_to(log_op[:, 1][None], (N_SHARDS, w)),
+        role_s[:, :, 0], role_s[:, :, 1]], axis=1)
+    return op_s, lane_tbl, lane_key, lane_val
+
+
+def pipe_step(stacked: tatp.Shard, c1: PipeCtx, c2: PipeCtx, key, *, w: int,
+              n_sub: int, val_words: int, gen_new: bool = True, mix=None):
+    """One pipelined device step: wave 1 of a NEW cohort + wave 2 of c1 +
+    wave 3 of c2, in a single vmapped engine step. Returns
+    (stacked', new_ctx, c1', stats-of-c2) — c2 completes here.
+
+    ``gen_new=False`` (static) feeds an empty cohort instead of generating
+    one: used to drain the pipeline at end of run."""
+    step_v = jax.vmap(tatp.step)
+    kg, kv3 = jax.random.split(key)
+    r = w * K
+    sid = jnp.arange(N_SHARDS, dtype=I32)
+
+    # ---- assemble the combined batch [12w lanes] ---------------------------
+    if gen_new:
+        ttype, ops, tbl, kk, ws = gen_cohort(kg, w, n_sub, mix=mix)
+        ws_active, ws_lane, ws_tbl, ws_key, ws_kind = ws
+    else:
+        e = empty_ctx(w)
+        ttype = jnp.zeros((w,), I32)
+        ops, tbl, kk = e.ops, e.tbl, e.kk
+        ws_active, ws_lane = e.ws_active, jnp.zeros((w, 2), I32)
+        ws_tbl, ws_key, ws_kind = e.ws_tbl, e.ws_key, e.ws_kind
+    a_op, a_tbl, a_key, a_owner, a_used = _wave1_lanes(ops, tbl, kk)
+    opA_s = jnp.where((a_owner[None] == sid[:, None]) & a_used[None],
+                      a_op[None], Op.NOP)
+
+    b_op, b_tbl, b_key, b_owner, b_used, is_read_lane = _validate_lanes(c1)
+    opB_s = jnp.where((b_owner[None] == sid[:, None]) & b_used[None],
+                      b_op[None], Op.NOP)
+
+    opC_s, c_tbl, c_key, c_val = _wave3_lanes(c2, kv3, val_words)
+
+    zvalAB = jnp.zeros((2 * r, val_words), U32)
+    lane_tbl = jnp.concatenate([a_tbl, b_tbl, c_tbl])
+    lane_key = jnp.concatenate([a_key, b_key, c_key])
+    lane_val = jnp.concatenate([zvalAB, c_val])
+    op_s = jnp.concatenate([opA_s, opB_s, opC_s], axis=1)
+    zver = jnp.zeros((lane_key.shape[0],), U32)
+
+    stacked, rep = step_v(stacked, _broadcast_batch(op_s, lane_tbl, lane_key,
+                                                    lane_val, zver))
+
+    # ---- wave-1 outcome for the new cohort --------------------------------
+    rtA = _merge(a_owner, rep.rtype[:, :r]).reshape(w, K)
+    rvA = _merge(a_owner, rep.val[:, :r])
+    rverA = _merge(a_owner, rep.ver[:, :r]).reshape(w, K)
+    is_val_lane = rtA.reshape(r) == Reply.VAL
+    magic_bad = jnp.sum(is_val_lane & (rvA[:, 1] != MAGIC), dtype=I32)
+
+    t = ttype
+    is_ro = ((t == wl.TATP_GET_SUBSCRIBER) | (t == wl.TATP_GET_ACCESS)
+             | (t == wl.TATP_GET_NEW_DEST)) & (ops[:, 0] != Op.NOP)
+    rw = (ops[:, 0] != Op.NOP) & ~is_ro
+
+    ws_rt = jnp.take_along_axis(rtA, ws_lane, axis=1)
+    granted = ws_active & (ws_rt == Reply.GRANT)
+    lock_rejected = (ws_active & (ws_rt == Reply.REJECT)).any(axis=1)
+
+    missing = jnp.zeros((w,), bool)
+    m = t == wl.TATP_GET_NEW_DEST
+    missing |= m & (rtA[:, 0] != Reply.VAL)
+    m = (t == wl.TATP_UPDATE_SUBSCRIBER) | (t == wl.TATP_UPDATE_LOCATION)
+    missing |= m & ((rtA[:, 0] != Reply.VAL) | (rtA[:, 1] != Reply.VAL))
+    m = t == wl.TATP_INSERT_CF
+    missing |= m & ((rtA[:, 0] != Reply.VAL) | (rtA[:, 1] == Reply.VAL))
+    m = t == wl.TATP_DELETE_CF
+    missing |= m & (rtA[:, 0] != Reply.VAL)
+    missing &= (ops[:, 0] != Op.NOP)
+
+    new_ctx = PipeCtx(
+        ops=ops, tbl=tbl, kk=kk, rver1=rverA, rt1_val=(rtA == Reply.VAL),
+        granted=granted, alive=rw & ~lock_rejected & ~missing,
+        ro_commit=is_ro & ~missing,
+        ws_active=ws_active, ws_tbl=ws_tbl, ws_key=ws_key, ws_kind=ws_kind,
+        attempted=jnp.asarray(w if gen_new else 0, I32),
+        ab_lock=(rw & lock_rejected).sum(dtype=I32),
+        ab_missing=((rw & ~lock_rejected & missing)
+                    | (is_ro & missing)).sum(dtype=I32),
+        ab_validate=jnp.asarray(0, I32),
+        magic_bad=magic_bad)
+
+    # ---- validate outcome for c1 ------------------------------------------
+    rtB = _merge(b_owner, rep.rtype[:, r:2 * r]).reshape(w, K)
+    rverB = _merge(b_owner, rep.ver[:, r:2 * r]).reshape(w, K)
+    bad_lane = is_read_lane & ((rverB != c1.rver1)
+                               | ((rtB != Reply.VAL) & c1.rt1_val))
+    changed = bad_lane.any(axis=1)
+    c1 = c1.replace(alive=c1.alive & ~changed,
+                    ab_validate=(c1.alive & changed).sum(dtype=I32))
+
+    # ---- c2 completed: emit its stats -------------------------------------
+    stats = jnp.stack([
+        c2.attempted,
+        (c2.ro_commit | c2.alive).sum(dtype=I32),
+        c2.ab_lock, c2.ab_missing, c2.ab_validate, c2.magic_bad])
+    return stacked, new_ctx, c1, stats
+
+
+def build_pipelined_runner(n_sub: int, w: int = 4096, val_words: int = 10,
+                           cohorts_per_block: int = 8, mix=None):
+    """jit(scan(pipe_step)) over carry (stacked, c1, c2): one dispatch runs
+    `cohorts_per_block` pipelined cohorts; in-flight cohorts persist across
+    blocks via the carry, so nothing is lost at block boundaries.
+
+    Returns (run, init, drain):
+      run(carry, key) -> (carry', stats [cohorts_per_block, N_STATS])
+      init(stacked)   -> carry with two bootstrap (empty) cohorts in flight
+      drain(carry)    -> (stacked, stats [2, N_STATS]) flushing the pipeline
+    """
+    kw = dict(w=w, n_sub=n_sub, val_words=val_words)
+    kw_gen = dict(kw, mix=mix)
+
+    def scan_fn(carry, key):
+        stacked, c1, c2 = carry
+        stacked, new_ctx, c1, stats = pipe_step(stacked, c1, c2, key,
+                                                **kw_gen)
+        return (stacked, new_ctx, c1), stats
+
+    def block(carry, key):
+        keys = jax.random.split(key, cohorts_per_block)
+        return jax.lax.scan(scan_fn, carry, keys)
+
+    def init(stacked):
+        return (stacked, empty_ctx(w), empty_ctx(w))
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def drain(carry):
+        stacked, c1, c2 = carry
+        key = jax.random.PRNGKey(0)
+        stacked, _, c1, s1 = pipe_step(stacked, c1, c2, key, gen_new=False,
+                                       **kw)
+        stacked, _, _, s2 = pipe_step(stacked, empty_ctx(w), c1, key,
+                                      gen_new=False, **kw)
+        return stacked, jnp.stack([s1, s2])
+
+    return jax.jit(block, donate_argnums=0), init, drain
 
 
 def build_runner(n_sub: int, w: int = 4096, val_words: int = 10,
