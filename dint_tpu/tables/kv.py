@@ -203,7 +203,11 @@ def assign_two_choice(keys: np.ndarray, n_buckets: int, slots: int,
             return cur, within
         flip = over & (rng.random(len(keys)) < 0.7)
         choice ^= flip
-    raise ValueError("two-choice placement did not converge: table too small")
+    raise ValueError(
+        f"two-choice placement did not converge: {len(keys)} keys into "
+        f"{n_buckets} buckets x {slots} slots = {n_buckets * slots} capacity "
+        f"(load {len(keys) / (n_buckets * slots):.2f}; need <~0.9 — grow "
+        "cf_buckets / n_buckets)")
 
 
 def populate(table: KVTable, keys: np.ndarray, vals: np.ndarray,

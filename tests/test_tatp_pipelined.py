@@ -10,8 +10,10 @@ VW = 4
 
 def _run(n_sub, w, blocks, cohorts_per_block=2, seed=0, mix=None):
     rng = np.random.default_rng(seed)
-    shards, _ = tc.populate_shards(rng, n_sub, val_words=VW,
-                                   cf_buckets=1 << 12, cf_lock_slots=1 << 12)
+    # cf_buckets left to tatp.create's default sizing (~load<=0.25 at 4
+    # slots), which scales with n_sub — a hardcoded 1<<12 cannot hold the
+    # ~37.5k CF rows populated at n_sub=20_000
+    shards, _ = tc.populate_shards(rng, n_sub, val_words=VW)
     stacked = tp.stack_shards(shards)
     run, init, drain = tp.build_pipelined_runner(
         n_sub, w=w, val_words=VW, cohorts_per_block=cohorts_per_block,
@@ -57,7 +59,14 @@ def test_low_contention_mostly_commits():
     attempted = int(total[tp.STAT_ATTEMPTED])
     committed = int(total[tp.STAT_COMMITTED])
     rate = 1 - committed / attempted
-    assert rate < 0.05, rate
+    # ab_missing is population-driven, not contention: GET_NEW_DEST /
+    # DELETE_CF hit absent SF/CF rows by TATP spec (~8% of the mix fails
+    # row lookups regardless of load — the reference counts these as
+    # unsuccessful txns too, tatp/caladan/client_ebpf_shard.cc:567-596)
+    assert rate < 0.12, rate
+    # the CONTENTION aborts are what low load must keep near zero
+    contention = int(total[tp.STAT_AB_LOCK]) + int(total[tp.STAT_AB_VALIDATE])
+    assert contention / attempted < 0.01, total
     assert int(total[tp.STAT_MAGIC_BAD]) == 0
 
 
