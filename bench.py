@@ -7,12 +7,23 @@ Protocol mirrors the reference's measurement contract (BASELINE.md): TATP
 mix 35/35/10/2/14/2/2, NURand subscriber ids, 3 replicated shards
 (primary-backup, log x3 + bck x2 + prim commit pipeline), warmup then timed
 window, committed (goodput) txns/s. The whole coordinator pipeline runs
-on-device (engines/tatp_pipeline.py) — the TPU-first equivalent of the
-reference's client coordinator + 3 eBPF servers on one machine boundary.
-Extra JSON fields: "mode": "device_fused" (workload generated on device, no
-wire path — NOT comparable to the reference's over-the-network numbers
-without that caveat), abort_rate, and a smallbank goodput figure when the
+on-device via the sort-free dense engine with REAL cross-cohort concurrency
+(engines/tatp_dense.py: wave 1 of cohort t + validate of t-1 + commit of
+t-2 fused per step, live validation aborts) — the TPU-first equivalent of
+the reference's client coordinator + 3 eBPF servers on one machine
+boundary. Extra JSON fields: "mode": "device_fused_pipelined" (workload
+generated on device, no wire path — NOT comparable to the reference's
+over-the-network numbers without that caveat), the abort breakdown
+(ab_lock / ab_missing / ab_validate, client_ebpf_shard.cc:688-768), the
+full latency metric block (avg/p50/p99/p99.9 µs at cohort granularity: a
+txn's latency is its cohort's wave1->wave3 span = 3 pipeline steps,
+client_ebpf_shard.cc:368-377), and a smallbank goodput figure when the
 fused SmallBank pipeline runs.
+
+DINT_BENCH_PROFILE=1 adds a "profile" field (populate/compile seconds,
+per-block wall-time stats, per-step and per-txn device cost) so the time
+split is a recorded fact; DINT_BENCH_TRACE_DIR additionally saves a jax
+profiler trace of a few steady-state blocks.
 
 Resilience: the TPU backend behind the axon tunnel can hang or fail at init
 (observed: "Unable to initialize backend 'axon'" and indefinite hangs in
@@ -74,39 +85,105 @@ def _child_main():
     """The actual measurement (runs inside the timed child process)."""
     _apply_platform_override()
 
+    import time as _time
+
     import jax
     import numpy as np
 
     from dint_tpu import stats as st
-    from dint_tpu.clients import tatp_client as tc
-    from dint_tpu.engines import tatp_pipeline as tp
+    from dint_tpu.engines import tatp_dense as td
 
-    rng = np.random.default_rng(0)
-    shards, _ = tc.populate_shards(rng, N_SUBSCRIBERS, val_words=VAL_WORDS,
-                                   cf_buckets=1 << 19, cf_lock_slots=1 << 19)
-    stacked = tp.stack_shards(shards)
-    run = tp.build_runner(N_SUBSCRIBERS, w=WIDTH, val_words=VAL_WORDS,
-                          cohorts_per_block=BLOCK)
-    stacked, total, warm, dt, blocks = st.run_window(
-        run, stacked, jax.random.PRNGKey(0), WINDOW_S, tp.N_STATS,
-        warmup_blocks=2)
+    t0 = _time.time()
+    db = td.populate(np.random.default_rng(0), N_SUBSCRIBERS,
+                     val_words=VAL_WORDS)
+    run, init, drain = td.build_pipelined_runner(
+        N_SUBSCRIBERS, w=WIDTH, val_words=VAL_WORDS, cohorts_per_block=BLOCK)
+    carry = init(db)
+    populate_s = _time.time() - t0
 
-    committed = int(total[tp.STAT_COMMITTED])
-    attempted = int(total[tp.STAT_ATTEMPTED])
+    t0 = _time.time()
+    carry, stats0 = run(carry, jax.random.PRNGKey(99))
+    np.asarray(stats0)  # fetch = sync (compile + first block)
+    compile_s = _time.time() - t0
+
+    carry, total, warm, dt, blocks, block_s = st.run_window(
+        run, carry, jax.random.PRNGKey(0), WINDOW_S, td.N_STATS,
+        warmup_blocks=1)
+
+    trace_dir = os.environ.get("DINT_BENCH_TRACE_DIR") \
+        if os.environ.get("DINT_BENCH_PROFILE") == "1" else None
+    trace_err = None
+    if trace_dir:   # must precede drain: drain donates the carry
+        try:
+            with jax.profiler.trace(trace_dir):
+                carry, s = run(carry, jax.random.PRNGKey(1234))
+                np.asarray(s)
+        except Exception as e:
+            # run() donated the old carry; a mid-run failure leaves no
+            # usable carry to drain — keep the windowed measurement
+            trace_err = repr(e)[:200]
+            carry = None
+
+    if carry is not None:
+        _, tail = drain(carry)
+        # in-flight cohorts at window end emit their stats on completion
+        total = total + np.asarray(tail, np.int64).sum(axis=0)
+
+    committed = int(total[td.STAT_COMMITTED])
+    attempted = int(total[td.STAT_ATTEMPTED])
     tps = committed / dt
-    bad = int(total[tp.STAT_MAGIC_BAD] + warm[tp.STAT_MAGIC_BAD])
+    bad = int(total[td.STAT_MAGIC_BAD] + warm[td.STAT_MAGIC_BAD]
+              + np.asarray(stats0, np.int64).sum(axis=0)[td.STAT_MAGIC_BAD])
     if bad != 0:
         raise RuntimeError(f"magic-byte integrity violated: {bad} "
                            "bad VAL replies (table corruption)")
+
+    # latency at cohort granularity: each cohort's txns complete 3 pipeline
+    # steps after dispatch (wave1 -> validate -> commit); a steady-state
+    # block of BLOCK steps takes block_s, so per-txn latency = 3 steps.
+    # Drop the first sample (dispatch-only, async) and the last (run_window
+    # folds the final queue-drain fetch into it, ~2x a steady-state block).
+    steady = block_s[1:-1] if len(block_s) > 2 else block_s
+    lat = st.LatencyReservoir()
+    for b in steady:
+        lat.add(np.full(BLOCK, 3.0 * b / BLOCK * 1e6))
+    p = lat.percentiles()
 
     out = {
         "metric": "tatp_committed_txns_per_sec",
         "value": round(tps, 1),
         "unit": "txn/s",
         "vs_baseline": round(tps / ASSUMED_BASELINE, 4),
-        "mode": "device_fused",
+        "mode": "device_fused_pipelined",
+        "throughput": round(attempted / dt, 1),
         "abort_rate": round(1 - committed / max(attempted, 1), 5),
+        "ab_lock": int(total[td.STAT_AB_LOCK]),
+        "ab_missing": int(total[td.STAT_AB_MISSING]),
+        "ab_validate": int(total[td.STAT_AB_VALIDATE]),
+        "avg_us": round(p["avg"], 1),
+        "p50_us": round(p["p50"], 1),
+        "p99_us": round(p["p99"], 1),
+        "p999_us": round(p["p999"], 1),
+        "n_subscribers": N_SUBSCRIBERS,
+        "width": WIDTH,
+        "blocks": blocks,
+        "window_s": round(dt, 2),
     }
+    if os.environ.get("DINT_BENCH_PROFILE") == "1":
+        bs = np.asarray(steady)
+        out["profile"] = {
+            "populate_s": round(populate_s, 2),
+            "compile_s": round(compile_s, 2),
+            "block_ms_min": round(float(bs.min()) * 1e3, 2),
+            "block_ms_mean": round(float(bs.mean()) * 1e3, 2),
+            "block_ms_max": round(float(bs.max()) * 1e3, 2),
+            "step_ms": round(float(bs.min()) / BLOCK * 1e3, 3),
+            "txn_ns": round(float(bs.min()) / (BLOCK * WIDTH) * 1e9, 1),
+        }
+        if trace_dir:
+            out["profile"]["trace_dir"] = trace_dir
+            if trace_err:
+                out["profile"]["trace_error"] = trace_err
     # headline line FIRST: if the smallbank leg hangs past the child timeout,
     # the parent salvages this line instead of losing the TATP measurement.
     print(json.dumps(out), flush=True)

@@ -30,7 +30,7 @@ def run(window_s: float = 10.0, n_accounts: int = N_ACCOUNTS,
     runner = sp.build_runner(n_accounts, w=width, cohorts_per_block=block)
     key = jax.random.PRNGKey(1)
 
-    stacked, total, warm, dt, _ = stats.run_window(
+    stacked, total, warm, dt, _, _ = stats.run_window(
         runner, stacked, key, window_s, sp.N_STATS, warmup_blocks=1)
 
     committed = int(total[sp.STAT_COMMITTED])

@@ -1,0 +1,385 @@
+"""Sort-free dense TATP engine: the TPU-first fast path.
+
+The generic engine (engines/tatp.py) resolves intra-batch conflicts with
+64-bit sorts + segmented reductions over EVERY lane x 3 vmapped shard
+replicas — protocol-faithful but ~200x off the reference's throughput
+(tatp/ebpf/shard_kern.c:111-197 does one hash + one CAS per packet). This
+module is the redesign that removes the sort entirely, exploiting three
+structural facts the reference cannot:
+
+1. **Every TATP table is dense-indexable.** SUBSCRIBER/SEC_SUBSCRIBER/
+   ACCESS_INFO/SPECIAL_FACILITY index by s_id directly (tatp/caladan/
+   tatp.h:28), and even CALL_FORWARDING's composite key
+   ``s_id*12 + (sf_type-1)*3 + start_time/8`` is bounded by 12*(n_sub+1),
+   so the "sparse" table is a dense array plus an `exists` bit. The
+   reference hashes it (tatp/ebpf/shard_kern.c:61-108) only because its
+   kvs.h is generic; no bloom filter is needed when lookups are exact.
+   All 5 tables live in ONE flat row-id space:
+   rows [0,p1) sub | [p1,2p1) sec | [2p1,6p1) ai | [6p1,10p1) sf |
+   [10p1,22p1) cf, with row N as the gather/scatter sentinel for NOP lanes.
+
+2. **The 3 servers' lock tables partition by key.** Locks for key k are
+   only ever taken at server k%3 (tatp/caladan/client_ebpf_shard.cc:
+   636-641), so the union of the 3 per-server lock arrays is one exact
+   per-row bool array — no routing, no hash conflation (exact locks also
+   remove the reference's false REJECT_LOCK on hash collisions, the
+   ablation its lock_kern.c instrumentation exists to measure).
+
+3. **Replicas are bit-identical by construction.** Every certified write
+   applies at primary + both backups (client_ebpf_shard.cc:779-900), so
+   val/ver/exists carry a leading [3] replica axis written with one
+   broadcast scatter; reads gather from replica 0 == the owner's copy.
+   The replica axis is the unit that shards across chips in the
+   multi-chip mesh (parallel/sharded.py).
+
+Conflict resolution per fused step (replacing ops/segments.sort_batch):
+  * commits: X-certified one-writer-per-row -> direct scatter.
+  * lock acquires: first-lane-wins via scatter-min of lane index into a
+    per-row winner scratch, then a gather-back compare — the batched
+    equivalent of the reference's CAS loop (shard_kern.c:251-297).
+  * reads/validates: pure gathers.
+Versions are monotonic: commit/insert/delete all bump ver, so OCC validate
+is a single u32 compare with no delete/reinsert ABA window.
+
+Scatter discipline (TPU): every table scatter is row-major on axis 0 with
+``unique_indices=True`` and masked lanes routed OUT OF BOUNDS under
+``mode="drop"`` — duplicate-index scatters serialize on TPU (measured
+89 ms for one [2w]-row update into [3, N, VW] on axis 1 vs row-major
+unique scatters), and uniqueness is guaranteed by certification (one
+X-lock holder per row). Row N is a never-written sentinel that NOP lanes
+gather from; OOB gather indices clip onto it.
+
+The 3-stage software pipeline (wave 1 of cohort t + validate of t-1 +
+commit of t-2 fused into ONE device program) is inherited from
+engines/tatp_pipeline.py, which remains the semantics reference; its
+gen_cohort (txn mix, NURand, lane layout) is reused verbatim.
+
+Memory: ~22*(n_sub+1) rows; val replicas dominate at 3*N*VW u32. At the
+bench's n_sub=1e5 that's ~260 MB — single-chip HBM. Reference scale
+(n_sub=7e6) needs the multi-chip shard path, as it does for the reference
+(3 servers).
+"""
+from __future__ import annotations
+
+import functools
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..clients import workloads as wl
+from ..tables import log as logring
+from . import tatp
+from .types import Op, Reply
+from .tatp_pipeline import K, MAGIC, N_SHARDS, classify_wave1, gen_cohort
+from .tatp_pipeline import (STAT_ATTEMPTED, STAT_COMMITTED, STAT_AB_LOCK,     # noqa: F401 (re-exported)
+                            STAT_AB_MISSING, STAT_AB_VALIDATE, STAT_MAGIC_BAD,
+                            N_STATS)
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+BIG = jnp.int32(1 << 30)
+
+
+def _bases(p1: int) -> np.ndarray:
+    """Flat row-id base per table id (tatp.SUBSCRIBER..tatp.CALL_FORWARDING)."""
+    return np.cumsum([0, p1, p1, 4 * p1, 4 * p1]).astype(np.int32)
+
+
+def n_rows(n_sub: int) -> int:
+    return 22 * (n_sub + 1)
+
+
+@flax.struct.dataclass
+class DenseDB:
+    """All 5 TATP tables + locks + logs in flat dense arrays (row N is the
+    sentinel every NOP/padded lane gathers from; it is never written).
+    Replicas are the SECOND axis so table scatters are row-major."""
+    val: jax.Array      # u32 [N+1, 3, VW]   replica-identical values
+    ver: jax.Array      # u32 [N+1, 3]       monotonic (bumped by every write)
+    exists: jax.Array   # bool [N+1, 3]
+    locked: jax.Array   # bool [N+1]         union of the 3 servers' lock maps
+    log: logring.LogRing   # stacked [3] leading axis (log x3 replication)
+
+    @property
+    def n_sub(self):
+        return self.locked.shape[0] // 22 - 1
+
+
+def create(n_sub: int, val_words: int = 10, log_lanes: int = 16,
+           log_capacity: int = 1 << 20) -> DenseDB:
+    n1 = n_rows(n_sub) + 1
+    one_log = logring.create(log_lanes, log_capacity, val_words)
+    return DenseDB(
+        val=jnp.zeros((n1, N_SHARDS, val_words), U32),
+        ver=jnp.zeros((n1, N_SHARDS), U32),
+        exists=jnp.zeros((n1, N_SHARDS), bool),
+        locked=jnp.zeros((n1,), bool),
+        log=jax.tree.map(lambda x: jnp.stack([x] * N_SHARDS), one_log),
+    )
+
+
+def populate(rng: np.random.Generator, n_sub: int, val_words: int = 10,
+             **kw) -> DenseDB:
+    """Same population as clients/tatp_client.populate_shards (reference
+    populate: tatp/caladan/client_ebpf_shard.cc:96-341): all subscribers
+    present, ai/sf types present w.p. 0.625 (>=1 each), CF rows on 25% of
+    present sf rows per start_time; val word0 = row payload, word1 = magic
+    (tatp/caladan/tatp.h:67-72)."""
+    p1 = n_sub + 1
+    db = create(n_sub, val_words=val_words, **kw)
+    n1 = n_rows(n_sub) + 1
+    base = _bases(p1)
+
+    val = np.zeros((n1, val_words), np.uint32)
+    ver = np.zeros(n1, np.uint32)
+    exists = np.zeros(n1, bool)
+
+    def put(rows, payload):
+        val[rows, 0] = payload.astype(np.uint32)
+        val[rows, 1] = MAGIC
+        ver[rows] = 1
+        exists[rows] = True
+
+    s_ids = np.arange(1, p1)
+    put(base[tatp.SUBSCRIBER] + s_ids, s_ids)
+    put(base[tatp.SEC_SUBSCRIBER] + s_ids, s_ids)
+
+    ai_present = rng.random((p1, 4)) < 0.625
+    sf_present = rng.random((p1, 4)) < 0.625
+    ai_present[0] = sf_present[0] = False
+    ai_present[1:][ai_present[1:].sum(1) == 0, 0] = True
+    sf_present[1:][sf_present[1:].sum(1) == 0, 0] = True
+    ai_idx = np.nonzero(ai_present.reshape(-1))[0]
+    sf_idx = np.nonzero(sf_present.reshape(-1))[0]
+    put(base[tatp.ACCESS_INFO] + ai_idx, ai_idx)
+    put(base[tatp.SPECIAL_FACILITY] + sf_idx, sf_idx)
+
+    sfi, sft = np.nonzero(sf_present)
+    cf_keys = []
+    for st in (0, 8, 16):
+        mask = rng.random(len(sfi)) < 0.25
+        cf_keys.append(np.asarray(tatp.cf_key(sfi[mask], sft[mask] + 1, st)))
+    cf_keys = np.unique(np.concatenate(cf_keys)).astype(np.int64)
+    put(base[tatp.CALL_FORWARDING] + cf_keys, cf_keys)
+
+    return db.replace(
+        val=jnp.asarray(np.repeat(val[:, None], N_SHARDS, axis=1)),
+        ver=jnp.asarray(np.repeat(ver[:, None], N_SHARDS, axis=1)),
+        exists=jnp.asarray(np.repeat(exists[:, None], N_SHARDS, axis=1)),
+    )
+
+
+# ---------------------------------------------------------------- pipeline
+
+
+@flax.struct.dataclass
+class DenseCtx:
+    """An in-flight cohort between pipeline stages (cf. tatp_pipeline.PipeCtx
+    — row ids are precomputed once at wave 1). Bootstrap cohorts have
+    attempted == 0 and all-False masks."""
+    rows: jax.Array       # i32 [w, K] flat row ids (sentinel for NOP lanes)
+    is_read: jax.Array    # bool [w, K] OCC_READ lanes
+    rver1: jax.Array      # u32 [w, K] raw row versions at wave 1
+    alive: jax.Array      # bool [w]
+    ro_commit: jax.Array  # bool [w]
+    granted: jax.Array    # bool [w, 2]
+    ws_rows: jax.Array    # i32 [w, 2] write-slot row ids (sentinel if inactive)
+    ws_tbl: jax.Array     # i32 [w, 2]
+    ws_key: jax.Array     # i32 [w, 2] (logged key)
+    ws_kind: jax.Array    # i32 [w, 2] 0 commit / 1 insert / 2 delete
+    ws_active: jax.Array  # bool [w, 2]
+    attempted: jax.Array  # i32 scalar
+    ab_lock: jax.Array    # i32 scalar
+    ab_missing: jax.Array # i32 scalar
+    ab_validate: jax.Array  # i32 scalar
+    magic_bad: jax.Array  # i32 scalar
+
+
+def empty_ctx(w: int) -> DenseCtx:
+    def z(shape, dt):
+        return jnp.asarray(np.zeros(shape, dt))
+
+    return DenseCtx(
+        rows=z((w, K), np.int32), is_read=z((w, K), bool),
+        rver1=z((w, K), np.uint32), alive=z((w,), bool),
+        ro_commit=z((w,), bool), granted=z((w, 2), bool),
+        ws_rows=z((w, 2), np.int32), ws_tbl=z((w, 2), np.int32),
+        ws_key=z((w, 2), np.int32), ws_kind=z((w, 2), np.int32),
+        ws_active=z((w, 2), bool),
+        attempted=z((), np.int32), ab_lock=z((), np.int32),
+        ab_missing=z((), np.int32), ab_validate=z((), np.int32),
+        magic_bad=z((), np.int32))
+
+
+def _stats_of(c: DenseCtx):
+    return jnp.stack([
+        c.attempted, (c.ro_commit | c.alive).sum(dtype=I32),
+        c.ab_lock, c.ab_missing, c.ab_validate, c.magic_bad])
+
+
+def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
+              n_sub: int, val_words: int, gen_new: bool = True, mix=None):
+    """One fused device step: commit wave of c2, validate wave of c1, and
+    read+lock wave of a NEW cohort — ordered commits -> reads -> locks per
+    row exactly like the generic engine's phase order (engines/tatp.
+    _dense_step), so cohort t-2's installs are visible to t-1's validation
+    and this step's reads, and its unlocks free rows for this step's lock
+    acquires. Returns (db', new_ctx, c1', stats-of-c2)."""
+    p1 = n_sub + 1
+    n1 = n_rows(n_sub) + 1
+    sent = n1 - 1     # sentinel row: gathered by NOP lanes, never written
+    oob = n1          # scatter index for masked lanes under mode="drop"
+    base = jnp.asarray(_bases(p1))
+    kg, kv3 = jax.random.split(key)
+
+    # ---- wave 3 of c2: install + unlock + log -----------------------------
+    do_write = c2.ws_active & c2.alive[:, None]                 # [w, 2]
+    wmask = do_write.reshape(-1)
+    wrows = jnp.where(wmask, c2.ws_rows.reshape(-1), oob)       # [2w]
+    wkind = c2.ws_kind.reshape(-1)
+    payload = jax.random.randint(kv3, (w, 2), 0, 1 << 16, dtype=I32)
+    newval = jnp.zeros((w, 2, val_words), U32)
+    newval = newval.at[:, :, 0].set(payload.astype(U32))
+    newval = newval.at[:, :, 1].set(
+        jnp.where(do_write & (c2.ws_kind != 2), U32(MAGIC), U32(0)))
+    newval = newval.reshape(-1, val_words)
+    newval = jnp.where((wkind == 2)[:, None], U32(0), newval)   # delete zeroes
+
+    oldver = db.ver[jnp.clip(wrows, 0, sent), 0]
+    newver = oldver + 1                     # monotonic: no delete/insert ABA
+    newex = wkind != 2
+
+    # one row-major scatter per array installs at primary + both backups
+    # (log x3 + bck x2 + prim install, client_ebpf_shard.cc:779-900);
+    # X-certification guarantees row uniqueness among unmasked lanes
+    def rep(x):
+        return jnp.broadcast_to(x[:, None], x.shape[:1] + (N_SHARDS,)
+                                + x.shape[1:])
+
+    val = db.val.at[wrows].set(rep(newval), mode="drop",
+                               unique_indices=True)
+    ver = db.ver.at[wrows].set(rep(newver), mode="drop",
+                               unique_indices=True)
+    exists = db.exists.at[wrows].set(rep(newex), mode="drop",
+                                     unique_indices=True)
+
+    # every granted lock releases here: COMMIT/INSERT/DELETE_PRIM for alive
+    # txns, ABORT for dead ones (client_ebpf_shard.cc:681-703)
+    unlock_rows = jnp.where(c2.granted.reshape(-1),
+                            c2.ws_rows.reshape(-1), oob)
+    locked = db.locked.at[unlock_rows].set(False, mode="drop",
+                                           unique_indices=True)
+
+    flags_del = (wkind == 2).astype(I32)
+    log_tbl = c2.ws_tbl.reshape(-1)
+    log_key = c2.ws_key.reshape(-1).astype(U32)
+    zero_hi = jnp.zeros_like(log_key)
+    logs = jax.vmap(
+        lambda ring: logring.append(ring, do_write.reshape(-1), log_tbl,
+                                    flags_del, zero_hi, log_key, newver,
+                                    newval)[0])(db.log)
+
+    # ---- wave 2 of c1: validate read-set version compare ------------------
+    vver = ver[c1.rows, 0]                                      # [w, K]
+    bad = c1.is_read & (vver != c1.rver1)
+    changed = bad.any(axis=1)
+    c1 = c1.replace(alive=c1.alive & ~changed,
+                    ab_validate=(c1.alive & changed).sum(dtype=I32))
+
+    # ---- wave 1: new cohort read + lock -----------------------------------
+    if gen_new:
+        ttype, ops, tbl, kk, ws = gen_cohort(kg, w, n_sub, mix=mix)
+        ws_active, ws_lane, ws_tbl, ws_key, ws_kind = ws
+    else:
+        ttype = jnp.zeros((w,), I32)
+        ops = jnp.zeros((w, K), I32)
+        tbl = jnp.zeros((w, K), I32)
+        kk = jnp.zeros((w, K), I32)
+        ws_active = jnp.zeros((w, 2), bool)
+        ws_lane = jnp.zeros((w, 2), I32)
+        ws_tbl = jnp.zeros((w, 2), I32)
+        ws_key = jnp.zeros((w, 2), I32)
+        ws_kind = jnp.zeros((w, 2), I32)
+
+    used = ops != Op.NOP
+    rows = jnp.where(used, base[tbl] + kk, sent)                # [w, K]
+    is_read = ops == Op.OCC_READ
+    is_lock = ops == Op.OCC_LOCK
+
+    rver1 = ver[rows, 0]
+    rex = exists[rows, 0]
+    rmagic = val[rows, 0, 1]
+    magic_bad = jnp.sum(is_read & rex & (rmagic != MAGIC), dtype=I32)
+
+    # lock arbitration: first lane wins per row (batched CAS,
+    # tatp/ebpf/shard_kern.c:251-297); losers and held rows REJECT
+    flat_rows = rows.reshape(-1)
+    flat_lock = is_lock.reshape(-1)
+    lane_idx = jnp.arange(w * K, dtype=I32)
+    arb_rows = jnp.where(flat_lock, flat_rows, oob)
+    winner = jnp.full((n1,), BIG, I32).at[arb_rows].min(lane_idx,
+                                                        mode="drop")
+    grant_flat = flat_lock & ~locked[flat_rows] & (winner[flat_rows] == lane_idx)
+    locked = locked.at[jnp.where(grant_flat, flat_rows, oob)].set(
+        True, mode="drop", unique_indices=True)
+    grant = grant_flat.reshape(w, K)
+
+    # reply types [w, K]: VAL/NOT_EXIST for reads, GRANT/REJECT for locks
+    rt = jnp.where(is_read, jnp.where(rex, Reply.VAL, Reply.NOT_EXIST),
+                   jnp.where(is_lock,
+                             jnp.where(grant, Reply.GRANT, Reply.REJECT),
+                             Reply.NONE))
+
+    # ---- wave-1 outcome: shared per-txn-type rules ------------------------
+    is_ro, rw, granted, lock_rejected, missing = classify_wave1(
+        ttype, rt, ops, ws_active, ws_lane)
+
+    ws_rows = jnp.where(ws_active, base[ws_tbl] + ws_key, sent)
+    new_ctx = DenseCtx(
+        rows=rows, is_read=is_read & used, rver1=rver1,
+        alive=rw & ~lock_rejected & ~missing,
+        ro_commit=is_ro & ~missing, granted=granted,
+        ws_rows=ws_rows, ws_tbl=ws_tbl, ws_key=ws_key, ws_kind=ws_kind,
+        ws_active=ws_active,
+        attempted=jnp.asarray(w if gen_new else 0, I32),
+        ab_lock=(rw & lock_rejected).sum(dtype=I32),
+        ab_missing=((rw & ~lock_rejected & missing)
+                    | (is_ro & missing)).sum(dtype=I32),
+        ab_validate=jnp.asarray(0, I32),
+        magic_bad=magic_bad)
+
+    db = db.replace(val=val, ver=ver, exists=exists, locked=locked, log=logs)
+    return db, new_ctx, c1, _stats_of(c2)
+
+
+def build_pipelined_runner(n_sub: int, w: int = 8192, val_words: int = 10,
+                           cohorts_per_block: int = 8, mix=None):
+    """jit(scan(pipe_step)) over carry (db, c1, c2); same contract as
+    tatp_pipeline.build_pipelined_runner: returns (run, init, drain)."""
+    kw = dict(w=w, n_sub=n_sub, val_words=val_words)
+
+    def scan_fn(carry, key):
+        db, c1, c2 = carry
+        db, new_ctx, c1, stats = pipe_step(db, c1, c2, key, mix=mix, **kw)
+        return (db, new_ctx, c1), stats
+
+    def block(carry, key):
+        keys = jax.random.split(key, cohorts_per_block)
+        return jax.lax.scan(scan_fn, carry, keys)
+
+    def init(db):
+        return (db, empty_ctx(w), empty_ctx(w))
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def drain(carry):
+        db, c1, c2 = carry
+        key = jax.random.PRNGKey(0)
+        db, _, c1, s1 = pipe_step(db, c1, c2, key, gen_new=False, **kw)
+        db, _, _, s2 = pipe_step(db, empty_ctx(w), c1, key, gen_new=False,
+                                 **kw)
+        return db, jnp.stack([s1, s2])
+
+    return jax.jit(block, donate_argnums=0), init, drain

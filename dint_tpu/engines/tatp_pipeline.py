@@ -205,25 +205,10 @@ def cohort_step(stacked: tatp.Shard, key, *, w: int, n_sub: int,
     is_val_lane = rt1.reshape(r) == Reply.VAL
     magic_bad = jnp.sum(is_val_lane & (rv1[:, 1] != MAGIC), dtype=I32)
 
-    # ---- outcome of wave 1 -------------------------------------------------
-    t = ttype
-    is_ro = ((t == wl.TATP_GET_SUBSCRIBER) | (t == wl.TATP_GET_ACCESS)
-             | (t == wl.TATP_GET_NEW_DEST))
-    rw = ~is_ro
-
-    ws_rt = jnp.take_along_axis(rt1, ws_lane, axis=1)      # [w, 2]
-    granted = ws_active & (ws_rt == Reply.GRANT)
-    lock_rejected = (ws_active & (ws_rt == Reply.REJECT)).any(axis=1)
-
-    missing = jnp.zeros((w,), bool)
-    m = t == wl.TATP_GET_NEW_DEST
-    missing |= m & (rt1[:, 0] != Reply.VAL)
-    m = (t == wl.TATP_UPDATE_SUBSCRIBER) | (t == wl.TATP_UPDATE_LOCATION)
-    missing |= m & ((rt1[:, 0] != Reply.VAL) | (rt1[:, 1] != Reply.VAL))
-    m = t == wl.TATP_INSERT_CF
-    missing |= m & ((rt1[:, 0] != Reply.VAL) | (rt1[:, 1] == Reply.VAL))
-    m = t == wl.TATP_DELETE_CF
-    missing |= m & (rt1[:, 0] != Reply.VAL)
+    # ---- outcome of wave 1 (generated cohorts always have a lane-0 op, so
+    # classify_wave1's NOP guard is vacuous here) ---------------------------
+    is_ro, rw, granted, lock_rejected, missing = classify_wave1(
+        ttype, rt1, ops, ws_active, ws_lane)
 
     ab_lock = rw & lock_rejected
     ab_missing = rw & ~lock_rejected & missing
@@ -374,6 +359,37 @@ def empty_ctx(w: int) -> PipeCtx:
         magic_bad=z((), np.int32))
 
 
+def classify_wave1(ttype, rt, ops, ws_active, ws_lane):
+    """Per-txn-type wave-1 outcome rules, shared by every TATP engine.
+
+    Given reply types rt [w, K] (VAL/NOT_EXIST for reads, GRANT/REJECT for
+    locks), classifies each txn exactly like the reference coordinator
+    (read-only commit on success, REJECT -> lock abort, required-row
+    absence / insert-exists -> missing abort; client_ebpf_shard.cc:608-703).
+    Returns (is_ro, rw, granted [w,2], lock_rejected, missing), all masked
+    to lanes that exist (ops[:,0] != NOP for bootstrap/drain cohorts)."""
+    t = ttype
+    is_ro = ((t == wl.TATP_GET_SUBSCRIBER) | (t == wl.TATP_GET_ACCESS)
+             | (t == wl.TATP_GET_NEW_DEST)) & (ops[:, 0] != Op.NOP)
+    rw = (ops[:, 0] != Op.NOP) & ~is_ro
+
+    ws_rt = jnp.take_along_axis(rt, ws_lane, axis=1)
+    granted = ws_active & (ws_rt == Reply.GRANT)
+    lock_rejected = (ws_active & (ws_rt == Reply.REJECT)).any(axis=1)
+
+    missing = jnp.zeros(t.shape, bool)
+    m = t == wl.TATP_GET_NEW_DEST
+    missing |= m & (rt[:, 0] != Reply.VAL)
+    m = (t == wl.TATP_UPDATE_SUBSCRIBER) | (t == wl.TATP_UPDATE_LOCATION)
+    missing |= m & ((rt[:, 0] != Reply.VAL) | (rt[:, 1] != Reply.VAL))
+    m = t == wl.TATP_INSERT_CF
+    missing |= m & ((rt[:, 0] != Reply.VAL) | (rt[:, 1] == Reply.VAL))
+    m = t == wl.TATP_DELETE_CF
+    missing |= m & (rt[:, 0] != Reply.VAL)
+    missing &= (ops[:, 0] != Op.NOP)
+    return is_ro, rw, granted, lock_rejected, missing
+
+
 def _wave1_lanes(ops, tbl, kk):
     """Flat wave-1 lane arrays + owner routing ([r] each, r = w*K)."""
     r = ops.shape[0] * K
@@ -492,25 +508,8 @@ def pipe_step(stacked: tatp.Shard, c1: PipeCtx, c2: PipeCtx, key, *, w: int,
     is_val_lane = rtA.reshape(r) == Reply.VAL
     magic_bad = jnp.sum(is_val_lane & (rvA[:, 1] != MAGIC), dtype=I32)
 
-    t = ttype
-    is_ro = ((t == wl.TATP_GET_SUBSCRIBER) | (t == wl.TATP_GET_ACCESS)
-             | (t == wl.TATP_GET_NEW_DEST)) & (ops[:, 0] != Op.NOP)
-    rw = (ops[:, 0] != Op.NOP) & ~is_ro
-
-    ws_rt = jnp.take_along_axis(rtA, ws_lane, axis=1)
-    granted = ws_active & (ws_rt == Reply.GRANT)
-    lock_rejected = (ws_active & (ws_rt == Reply.REJECT)).any(axis=1)
-
-    missing = jnp.zeros((w,), bool)
-    m = t == wl.TATP_GET_NEW_DEST
-    missing |= m & (rtA[:, 0] != Reply.VAL)
-    m = (t == wl.TATP_UPDATE_SUBSCRIBER) | (t == wl.TATP_UPDATE_LOCATION)
-    missing |= m & ((rtA[:, 0] != Reply.VAL) | (rtA[:, 1] != Reply.VAL))
-    m = t == wl.TATP_INSERT_CF
-    missing |= m & ((rtA[:, 0] != Reply.VAL) | (rtA[:, 1] == Reply.VAL))
-    m = t == wl.TATP_DELETE_CF
-    missing |= m & (rtA[:, 0] != Reply.VAL)
-    missing &= (ops[:, 0] != Op.NOP)
+    is_ro, rw, granted, lock_rejected, missing = classify_wave1(
+        ttype, rtA, ops, ws_active, ws_lane)
 
     new_ctx = PipeCtx(
         ops=ops, tbl=tbl, kk=kk, rver1=rverA, rt1_val=(rtA == Reply.VAL),

@@ -128,9 +128,12 @@ def run_window(runner, state, key, window_s: float, n_stats: int,
     is the only honest window bracket.
 
     Returns (state, total [n_stats] i64, warm_total [n_stats] i64,
-    elapsed_s, blocks): `total` covers only the timed window; `warm_total`
-    covers warmup (callers with table-vs-accounting invariants need it —
-    warmup writes land in the tables too).
+    elapsed_s, blocks, block_s): `total` covers only the timed window;
+    `warm_total` covers warmup (callers with table-vs-accounting invariants
+    need it — warmup writes land in the tables too). `block_s` is the wall
+    time of each timed loop iteration (dispatch of block i + fetch of block
+    i-1's stats) — in steady state ≈ one block of device time, the basis
+    for cohort-granularity latency percentiles.
     """
     import jax
 
@@ -140,19 +143,26 @@ def run_window(runner, state, key, window_s: float, n_stats: int,
         warm_total += np.asarray(stats, np.int64).sum(axis=0)
 
     total = np.zeros(n_stats, np.int64)
+    block_s = []
     t0 = time.time()
     i = warmup_blocks
     pending = None
+    tprev = t0
     while time.time() - t0 < window_s:
         state, stats = runner(state, jax.random.fold_in(key, i))
         if pending is not None:
             total += np.asarray(pending, np.int64).sum(axis=0)
         pending = stats
         i += 1
+        now = time.time()
+        block_s.append(now - tprev)
+        tprev = now
     if pending is not None:
         total += np.asarray(pending, np.int64).sum(axis=0)  # fetch = sync
+        # the final fetch closes the last block's device time
+        block_s[-1] = time.time() - tprev + block_s[-1]
     dt = time.time() - t0
-    return state, total, warm_total, dt, i - warmup_blocks
+    return state, total, warm_total, dt, i - warmup_blocks, block_s
 
 
 @dataclasses.dataclass

@@ -1,0 +1,144 @@
+"""Sort-free dense TATP engine: semantics vs the generic pipelined engine."""
+import jax
+import numpy as np
+
+from dint_tpu.clients import tatp_client as tc
+from dint_tpu.engines import tatp, tatp_dense as td, tatp_pipeline as tp
+
+VW = 4
+
+
+def _run(n_sub, w, blocks, cohorts_per_block=2, seed=0, mix=None):
+    db = td.populate(np.random.default_rng(seed), n_sub, val_words=VW)
+    run, init, drain = td.build_pipelined_runner(
+        n_sub, w=w, val_words=VW, cohorts_per_block=cohorts_per_block,
+        mix=mix)
+    carry = init(db)
+    key = jax.random.PRNGKey(seed)
+    total = np.zeros(td.N_STATS, np.int64)
+    for i in range(blocks):
+        carry, stats = run(carry, jax.random.fold_in(key, i))
+        total += np.asarray(stats, np.int64).sum(axis=0)
+    db, tail = drain(carry)
+    total += np.asarray(tail, np.int64).sum(axis=0)
+    return db, total
+
+
+def test_contention_fires_validate_aborts():
+    # same forced US/IC-heavy mix over a tiny keyspace as the generic
+    # pipelined engine's test: in-flight cohorts commit sf rows between a
+    # younger cohort's read and its validate
+    mix = np.array([0, 0, 0, 50, 0, 50, 0], np.float64) / 100.0
+    db, total = _run(n_sub=32, w=256, blocks=4, mix=mix)
+    attempted = int(total[td.STAT_ATTEMPTED])
+    committed = int(total[td.STAT_COMMITTED])
+    assert attempted == 4 * 2 * 256
+    assert committed > 0
+    assert int(total[td.STAT_MAGIC_BAD]) == 0
+    assert int(total[td.STAT_AB_VALIDATE]) > 0
+    assert int(total[td.STAT_AB_LOCK]) > 0
+    outcomes = (committed + int(total[td.STAT_AB_LOCK])
+                + int(total[td.STAT_AB_MISSING])
+                + int(total[td.STAT_AB_VALIDATE]))
+    assert outcomes == attempted
+
+
+def test_low_contention_mostly_commits():
+    db, total = _run(n_sub=20_000, w=64, blocks=3)
+    attempted = int(total[td.STAT_ATTEMPTED])
+    committed = int(total[td.STAT_COMMITTED])
+    assert 1 - committed / attempted < 0.12
+    contention = int(total[td.STAT_AB_LOCK]) + int(total[td.STAT_AB_VALIDATE])
+    assert contention / attempted < 0.01, total
+    assert int(total[td.STAT_MAGIC_BAD]) == 0
+
+
+def test_drain_releases_locks_and_replicas_converge():
+    db, _ = _run(n_sub=64, w=128, blocks=3, seed=3)
+    assert not np.asarray(db.locked).any()
+    for arr in (db.val, db.ver, db.exists):
+        a = np.asarray(arr)   # replica axis 1
+        assert np.array_equal(a[:, 0], a[:, 1])
+        assert np.array_equal(a[:, 0], a[:, 2])
+    heads = np.asarray(db.log.head)
+    assert np.array_equal(heads[0], heads[1])
+    assert np.array_equal(heads[0], heads[2])
+    # sentinel row untouched
+    assert not np.asarray(db.exists)[-1].any()
+    assert (np.asarray(db.ver)[-1] == 0).all()
+
+
+def test_delete_only_mix_empties_cf():
+    # DELETE_CF-only mix over a tiny keyspace: every present CF row is
+    # eventually deleted; deletes log is_del entries and bump versions
+    mix = np.array([0, 0, 0, 0, 0, 0, 100], np.float64) / 100.0
+    n_sub = 4
+    db0 = td.populate(np.random.default_rng(0), n_sub, val_words=VW)
+    cf0 = np.asarray(db0.exists)[10 * (n_sub + 1):-1, 0]
+    assert cf0.any()
+    db, total = _run(n_sub=n_sub, w=128, blocks=6, mix=mix)
+    cf1 = np.asarray(db.exists)[10 * (n_sub + 1):-1, 0]
+    assert not cf1.any()
+    assert int(total[td.STAT_COMMITTED]) == int(cf0.sum())
+    # committed deletes bumped their rows' versions past populate's 1
+    vers = np.asarray(db.ver)[10 * (n_sub + 1):-1, 0]
+    assert (vers[cf0] >= 2).all()
+
+
+def test_insert_mix_fills_cf_and_versions_are_monotonic():
+    mix = np.array([0, 0, 0, 0, 0, 100, 0], np.float64) / 100.0
+    n_sub = 4
+    db0 = td.populate(np.random.default_rng(0), n_sub, val_words=VW)
+    cf0 = np.asarray(db0.exists)[10 * (n_sub + 1):-1, 0].sum()
+    db, total = _run(n_sub=n_sub, w=128, blocks=6, mix=mix)
+    cf1 = np.asarray(db.exists)[10 * (n_sub + 1):-1, 0].sum()
+    assert int(total[td.STAT_COMMITTED]) == cf1 - cf0
+    assert int(total[td.STAT_MAGIC_BAD]) == 0
+
+
+def test_matches_generic_pipelined_engine_at_low_contention():
+    """Same seed -> same population + same cohorts; at low contention the
+    dense engine must produce the exact same stats as the generic
+    sort-based engine (engines/tatp_pipeline): exact CF locks only remove
+    hash-conflation conflicts, which are absent at this scale."""
+    n_sub, w, blocks, seed = 2000, 256, 2, 7
+
+    db = td.populate(np.random.default_rng(seed), n_sub, val_words=VW)
+    run_d, init_d, drain_d = td.build_pipelined_runner(
+        n_sub, w=w, val_words=VW, cohorts_per_block=2)
+    carry = init_d(db)
+
+    shards, _ = tc.populate_shards(np.random.default_rng(seed), n_sub,
+                                   val_words=VW)
+    stacked = tp.stack_shards(shards)
+    run_g, init_g, drain_g = tp.build_pipelined_runner(
+        n_sub, w=w, val_words=VW, cohorts_per_block=2)
+    carry_g = init_g(stacked)
+
+    key = jax.random.PRNGKey(seed)
+    tot_d = np.zeros(td.N_STATS, np.int64)
+    tot_g = np.zeros(tp.N_STATS, np.int64)
+    for i in range(blocks):
+        carry, s_d = run_d(carry, jax.random.fold_in(key, i))
+        carry_g, s_g = run_g(carry_g, jax.random.fold_in(key, i))
+        tot_d += np.asarray(s_d, np.int64).sum(axis=0)
+        tot_g += np.asarray(s_g, np.int64).sum(axis=0)
+    db, tail_d = drain_d(carry)
+    stacked, tail_g = drain_g(carry_g)
+    tot_d += np.asarray(tail_d, np.int64).sum(axis=0)
+    tot_g += np.asarray(tail_g, np.int64).sum(axis=0)
+
+    assert tot_d.tolist() == tot_g.tolist(), (tot_d, tot_g)
+
+    # table end-states agree too: dense flat rows vs the generic engine's
+    # per-table arrays (dense tables only; CF layouts differ by design)
+    p1 = n_sub + 1
+    base = td._bases(p1)
+    ver_d = np.asarray(db.ver)[:, 0]
+    for tid, t in ((tatp.SUBSCRIBER, stacked.sub), (tatp.SEC_SUBSCRIBER,
+                   stacked.sec), (tatp.ACCESS_INFO, stacked.ai),
+                   (tatp.SPECIAL_FACILITY, stacked.sf)):
+        n = np.asarray(t.ver).shape[1]
+        got = ver_d[base[tid]:base[tid] + n]
+        want = np.asarray(t.ver)[0]
+        assert np.array_equal(got, want), tid
