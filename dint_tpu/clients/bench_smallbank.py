@@ -1,10 +1,13 @@
-"""SmallBank bench window: committed txn/s on the device-fused pipeline.
+"""SmallBank bench window: committed txn/s on the dense fused pipeline.
 
 Reference-scale parameters (BASELINE.md): 24M accounts x {SAVINGS, CHECKING},
 90% of txns on the 4% hot set, mix 15/15/15/25/15/15, 3 replicated shards
 with the log x3 / bck x2 / prim commit pipeline
 (smallbank/caladan/client_ebpf_shard.cc:389-560). Called from bench.py's
-child process; returns extra JSON fields for the headline line.
+child process; returns extra JSON fields for the headline line. Runs the
+sort-free dense engine (engines/smallbank_dense.py) with cross-cohort lock
+concurrency; the generic engine (engines/smallbank_pipeline.py) remains the
+semantics reference.
 
 The balance-conservation invariant is checked over the whole window:
 table-sum delta (mod 2^32) must equal the pipeline's own committed-delta
@@ -16,7 +19,7 @@ import jax
 import numpy as np
 
 from .. import stats
-from ..engines import smallbank_pipeline as sp
+from ..engines import smallbank_dense as sd
 
 N_ACCOUNTS = 24_000_000
 WIDTH = 8192
@@ -25,21 +28,27 @@ BLOCK = 16
 
 def run(window_s: float = 10.0, n_accounts: int = N_ACCOUNTS,
         width: int = WIDTH, block: int = BLOCK) -> dict:
-    stacked = sp.create_stacked(n_accounts)
-    base = int(np.asarray(sp.total_balance(stacked)))
-    runner = sp.build_runner(n_accounts, w=width, cohorts_per_block=block)
+    db = sd.create(n_accounts)
+    base = int(np.asarray(sd.total_balance(db)))
+    runner, init, drain = sd.build_pipelined_runner(
+        n_accounts, w=width, cohorts_per_block=block)
+    carry = init(db)
     key = jax.random.PRNGKey(1)
 
-    stacked, total, warm, dt, _, _ = stats.run_window(
-        runner, stacked, key, window_s, sp.N_STATS, warmup_blocks=1)
+    carry, total, warm, dt, _, _ = stats.run_window(
+        runner, carry, key, window_s, sd.N_STATS, warmup_blocks=1)
+    db, tail = drain(carry)
+    tail = np.asarray(tail, np.int64).sum(axis=0)
 
-    committed = int(total[sp.STAT_COMMITTED])
-    attempted = int(total[sp.STAT_ATTEMPTED])
-    if int(total[sp.STAT_MAGIC_BAD] + warm[sp.STAT_MAGIC_BAD]) != 0:
+    committed = int(total[sd.STAT_COMMITTED] + tail[sd.STAT_COMMITTED])
+    attempted = int(total[sd.STAT_ATTEMPTED] + tail[sd.STAT_ATTEMPTED])
+    if int(total[sd.STAT_MAGIC_BAD] + warm[sd.STAT_MAGIC_BAD]
+           + tail[sd.STAT_MAGIC_BAD]) != 0:
         raise RuntimeError("smallbank magic-byte integrity violated")
     # conservation covers the WHOLE run (warmup writes land in the tables too)
-    accounted = int(total[sp.STAT_BAL_DELTA] + warm[sp.STAT_BAL_DELTA])
-    final = int(np.asarray(sp.total_balance(stacked)))
+    accounted = int(total[sd.STAT_BAL_DELTA] + warm[sd.STAT_BAL_DELTA]
+                    + tail[sd.STAT_BAL_DELTA])
+    final = int(np.asarray(sd.total_balance(db)))
     if (final - base) % (1 << 32) != accounted % (1 << 32):
         raise RuntimeError(
             f"balance conservation violated: table delta {final - base} != "

@@ -1,0 +1,289 @@
+"""Sort-free dense SmallBank engine: the TPU-first fast path.
+
+Companion to engines/tatp_dense.py for the SmallBank workload, replacing
+the vmapped sort-based smallbank.step pair the device-fused pipeline pays
+per cohort (engines/smallbank_pipeline.py). Same structural moves:
+
+* SAVINGS/CHECKING are dense 0..N-1 (smallbank/ebpf/smallbank.h:20-66), so
+  both tables live in ONE flat row-id space: row = table*N + account, with
+  row M = 2N as the never-written gather sentinel.
+* The 3 servers' S/X lock tables partition by key%3
+  (smallbank/caladan/client_ebpf_shard.cc:287-289), so their union is one
+  exact pair of arrays: x_held bool [M+1] + s_count i32 [M+1].
+* Replicas are bit-identical by construction (CommitLog x3 + CommitBck x2 +
+  CommitPrim install everywhere), kept as axis 1 of val/ver and written by
+  one row-major unique scatter; reads gather replica 0.
+
+No-wait S/X arbitration without a sort (the closed form of processing a
+row's lock requests in lane order, == the reference's per-entry CAS +
+grant/reject counters, smallbank/ebpf/shard_kern.c:96-328):
+  first_x, first_s = per-row scatter-min of lane index over X / S requests
+  x_wins(row)      = first_x < first_s  and row free (no X held, no S held)
+  X grant          = x_wins and lane == first_x
+  S grant          = row has no X held and not x_wins
+(if any S precedes the first X, the X rejects and ALL batch S's share the
+row; if an X is first on a free row it takes it and everything else
+rejects.)
+
+The 2-stage software pipeline fuses, per device step,
+  wave 1 of cohort t     (S/X lock + fused balance read + compute),
+                         arbitrated against cohort t-1's STILL-HELD locks
+  wave 2 of cohort t-1   (install + release + log x3), applied after
+so locks are held across one step boundary and lock conflicts between
+consecutive cohorts are real concurrency, exactly like the reference's
+overlapping in-flight txns (acquire-before-release is what makes that
+true — a release-first order would hand every acquire an empty lock
+table). Per-txn balance logic is shared with the generic pipeline
+(smallbank_pipeline.compute_phase).
+"""
+from __future__ import annotations
+
+import functools
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tables import log as logring
+from . import smallbank
+from .types import Op
+from .smallbank_pipeline import (AMT, L, MAGIC, N_SHARDS, TS_AMT_MAX, VW,     # noqa: F401 (re-exported)
+                                 STAT_ATTEMPTED, STAT_COMMITTED, STAT_AB_LOCK,
+                                 STAT_AB_LOGIC, STAT_MAGIC_BAD, STAT_BAL_DELTA,
+                                 N_STATS, compute_phase, gen_cohort,
+                                 _lock_slots)
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+BIG = jnp.int32(1 << 30)
+
+
+@flax.struct.dataclass
+class DenseBank:
+    """Both tables + locks + logs in flat dense arrays (row M = 2N is the
+    gather sentinel; masked scatters route out of bounds and drop)."""
+    val: jax.Array       # u32 [M+1, 3, VW]  replica-identical values
+    ver: jax.Array       # u32 [M+1, 3]
+    x_held: jax.Array    # bool [M+1]  union of the 3 servers' X-lock maps
+    s_count: jax.Array   # i32 [M+1]   union of the 3 servers' S counts
+    log: logring.LogRing   # stacked [3] leading axis
+
+    @property
+    def n_accounts(self):
+        return self.x_held.shape[0] // 2
+
+
+def create(n_accounts: int, init_balance: int = 1000, log_lanes: int = 16,
+           log_capacity: int = 1 << 20) -> DenseBank:
+    """Populated on device (reference: smallbank/ebpf/shard_user.c:74-77);
+    every account starts at init_balance with the magic word set."""
+    m1 = 2 * n_accounts + 1
+    val = jnp.zeros((m1, N_SHARDS, VW), U32)
+    val = val.at[:-1, :, 0].set(U32(init_balance))
+    val = val.at[:-1, :, 1].set(U32(MAGIC))
+    ver = jnp.ones((m1, N_SHARDS), U32).at[-1].set(0)
+    one_log = logring.create(log_lanes, log_capacity, VW)
+    return DenseBank(
+        val=val, ver=ver,
+        x_held=jnp.zeros((m1,), bool),
+        s_count=jnp.zeros((m1,), I32),
+        log=jax.tree.map(lambda x: jnp.stack([x] * N_SHARDS), one_log),
+    )
+
+
+def total_balance(db: DenseBank, replica: int = 0):
+    """Device-side balance sum over one replica (mod 2^32, i32 accumulate —
+    conservation compares deltas under the same wraparound)."""
+    return db.val[:-1, replica, 0].astype(I32).sum(dtype=I32)
+
+
+@flax.struct.dataclass
+class BankCtx:
+    """A cohort between lock+compute (wave 1) and install+release (wave 2).
+    Stats are emitted when the writes land. Bootstrap cohorts have
+    attempted == 0 and all-False masks."""
+    rows: jax.Array      # i32 [w, L] flat row ids (sentinel if inactive)
+    granted: jax.Array   # bool [w, L]
+    is_x: jax.Array      # bool [w, L] granted lock is exclusive
+    do_write: jax.Array  # bool [w, L]
+    nw: jax.Array        # i32 [w, L] new balances
+    tbl: jax.Array       # i32 [w, L] (for the log)
+    acc: jax.Array       # i32 [w, L] (for the log)
+    attempted: jax.Array   # i32 scalar
+    committed: jax.Array   # i32 scalar
+    ab_lock: jax.Array     # i32 scalar
+    ab_logic: jax.Array    # i32 scalar
+    magic_bad: jax.Array   # i32 scalar
+    bal_delta: jax.Array   # i32 scalar
+
+
+def empty_ctx(w: int) -> BankCtx:
+    def z(shape, dt):
+        return jnp.asarray(np.zeros(shape, dt))
+
+    return BankCtx(
+        rows=z((w, L), np.int32), granted=z((w, L), bool),
+        is_x=z((w, L), bool), do_write=z((w, L), bool),
+        nw=z((w, L), np.int32), tbl=z((w, L), np.int32),
+        acc=z((w, L), np.int32),
+        attempted=z((), np.int32), committed=z((), np.int32),
+        ab_lock=z((), np.int32), ab_logic=z((), np.int32),
+        magic_bad=z((), np.int32), bal_delta=z((), np.int32))
+
+
+def _stats_of(c: BankCtx):
+    return jnp.stack([c.attempted, c.committed, c.ab_lock, c.ab_logic,
+                      c.magic_bad, c.bal_delta])
+
+
+def pipe_step(db: DenseBank, c1: BankCtx, key, *, w: int, n_accounts: int,
+              gen_new: bool = True, hot_frac=None, hot_prob=None, mix=None):
+    """One fused device step: wave 1 of a NEW cohort acquires against c1's
+    STILL-HELD locks, then wave 2 installs c1's writes and releases them.
+    Acquire-before-release is what makes cross-cohort lock conflicts real:
+    cohort t's locks are visible to cohort t+1's no-wait acquires, exactly
+    like the reference's overlapping in-flight txns. The order is safe for
+    the fused reads too — any row c1 is about to install was X-held by c1,
+    so the new cohort's acquire on it REJECTed and its (pre-install) value
+    is never consumed; S-held rows are unmodified by definition.
+    Returns (db', new_ctx, stats-of-c1)."""
+    m1 = 2 * n_accounts + 1
+    sent = m1 - 1
+    oob = m1
+    kgen, kamt = jax.random.split(key)
+
+    # ---- wave 1: new cohort lock + fused read + compute -------------------
+    if gen_new:
+        skew = {"mix": mix}
+        if hot_frac is not None:
+            skew["hot_frac"] = hot_frac
+        if hot_prob is not None:
+            skew["hot_prob"] = hot_prob
+        ttype, a1, a2 = gen_cohort(kgen, w, n_accounts, **skew)
+        l_op, l_tb, l_ac = _lock_slots(ttype, a1, a2)      # [w, L]
+    else:
+        ttype = jnp.zeros((w,), I32)
+        l_op = jnp.zeros((w, L), I32)
+        l_tb = jnp.zeros((w, L), I32)
+        l_ac = jnp.zeros((w, L), I32)
+    ts_amt = jax.random.randint(kamt, (w,), -TS_AMT_MAX, TS_AMT_MAX + 1,
+                                dtype=I32)
+
+    active = l_op != 0
+    rows = jnp.where(active, l_tb * n_accounts + l_ac, sent)  # [w, L]
+    flat_rows = rows.reshape(-1)
+    is_x_lane = (l_op == Op.ACQ_X_READ).reshape(-1)
+    is_s_lane = (l_op == Op.ACQ_S_READ).reshape(-1)
+    lane = jnp.arange(w * L, dtype=I32)
+
+    first_x = jnp.full((m1,), BIG, I32).at[
+        jnp.where(is_x_lane, flat_rows, oob)].min(lane, mode="drop")
+    first_s = jnp.full((m1,), BIG, I32).at[
+        jnp.where(is_s_lane, flat_rows, oob)].min(lane, mode="drop")
+    # arbitrate against c1's STILL-HELD locks (released below, after)
+    row_free = ~db.x_held & (db.s_count == 0)
+    x_wins = (first_x < first_s) & row_free
+    grant_x = is_x_lane & x_wins[flat_rows] & (first_x[flat_rows] == lane)
+    grant_s = is_s_lane & ~db.x_held[flat_rows] & ~x_wins[flat_rows]
+    x_held = db.x_held.at[jnp.where(grant_x, flat_rows, oob)].set(
+        True, mode="drop", unique_indices=True)
+    s_count = db.s_count.at[jnp.where(grant_s, flat_rows, oob)].add(
+        1, mode="drop")
+
+    granted = (grant_x | grant_s).reshape(w, L)
+    lock_rejected = (active & ~granted).any(axis=1)
+    alive = ~lock_rejected & (l_op[:, 0] != 0)
+
+    # fused reads from the pre-install tables: rows c1 will install below
+    # were X-held by c1, so this cohort never granted (or reads) them
+    gbal = db.val[flat_rows, 0, 0].astype(I32)
+    gmagic = db.val[flat_rows, 0, 1]
+    magic_bad = jnp.sum((grant_x | grant_s) & (gmagic != MAGIC), dtype=I32)
+    bal = jnp.where(granted, gbal.reshape(w, L), 0)
+
+    nw, do, logic_abort, commit, committed = compute_phase(
+        ttype, bal, alive, ts_amt)
+    do_write = do & commit[:, None] & active
+    bal_delta = jnp.sum(jnp.where(do_write, nw - bal, 0), dtype=I32)
+
+    new_ctx = BankCtx(
+        rows=rows, granted=granted, is_x=is_x_lane.reshape(w, L),
+        do_write=do_write, nw=nw, tbl=l_tb, acc=l_ac,
+        attempted=jnp.asarray(w if gen_new else 0, I32),
+        committed=committed.sum(dtype=I32),
+        ab_lock=(lock_rejected & (l_op[:, 0] != 0)).sum(dtype=I32),
+        ab_logic=logic_abort.sum(dtype=I32),
+        magic_bad=magic_bad,
+        bal_delta=bal_delta)
+
+    # ---- wave 2 of c1: install + release + log x3 -------------------------
+    dwf = c1.do_write.reshape(-1)
+    wrows = jnp.where(dwf, c1.rows.reshape(-1), oob)       # [wL]
+    newbal = c1.nw.reshape(-1)
+    newval = jnp.zeros((wrows.shape[0], VW), U32)
+    newval = newval.at[:, 0].set(newbal.astype(U32))
+    newval = newval.at[:, 1].set(jnp.where(dwf, U32(MAGIC), U32(0)))
+    newver = db.ver[jnp.clip(wrows, 0, sent), 0] + 1
+
+    def rep(x):
+        return jnp.broadcast_to(x[:, None], x.shape[:1] + (N_SHARDS,)
+                                + x.shape[1:])
+
+    val = db.val.at[wrows].set(rep(newval), mode="drop", unique_indices=True)
+    ver = db.ver.at[wrows].set(rep(newver), mode="drop", unique_indices=True)
+
+    # release c1's locks AFTER the new cohort's acquires saw them; X rows
+    # granted this step are disjoint from c1's (they were held), S counts
+    # compose by +/-
+    relx = (c1.granted & c1.is_x).reshape(-1)
+    rels = (c1.granted & ~c1.is_x).reshape(-1)
+    x_held = x_held.at[jnp.where(relx, c1.rows.reshape(-1), oob)].set(
+        False, mode="drop", unique_indices=True)
+    s_count = s_count.at[jnp.where(rels, c1.rows.reshape(-1), oob)].add(
+        -1, mode="drop")
+
+    zero = jnp.zeros_like(newbal, U32)
+    logs = jax.vmap(
+        lambda ring: logring.append(ring, dwf, c1.tbl.reshape(-1),
+                                    jnp.zeros_like(newbal), zero,
+                                    c1.acc.reshape(-1).astype(U32),
+                                    newver, newval)[0])(db.log)
+
+    db = db.replace(val=val, ver=ver, x_held=x_held, s_count=s_count,
+                    log=logs)
+    return db, new_ctx, _stats_of(c1)
+
+
+def build_pipelined_runner(n_accounts: int, w: int = 8192,
+                           cohorts_per_block: int = 8, hot_frac=None,
+                           hot_prob=None, mix=None):
+    """jit(scan(pipe_step)) over carry (db, c1). Returns (run, init, drain):
+      run(carry, key) -> (carry', stats [cohorts_per_block, N_STATS])
+      init(db)        -> carry with one bootstrap cohort in flight
+      drain(carry)    -> (db, stats [1, N_STATS]) flushing the pipeline
+    """
+    kw = dict(w=w, n_accounts=n_accounts)
+    kw_gen = dict(kw, hot_frac=hot_frac, hot_prob=hot_prob, mix=mix)
+
+    def scan_fn(carry, key):
+        db, c1 = carry
+        db, new_ctx, stats = pipe_step(db, c1, key, **kw_gen)
+        return (db, new_ctx), stats
+
+    def block(carry, key):
+        keys = jax.random.split(key, cohorts_per_block)
+        return jax.lax.scan(scan_fn, carry, keys)
+
+    def init(db):
+        return (db, empty_ctx(w))
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def drain(carry):
+        db, c1 = carry
+        db, _, s1 = pipe_step(db, c1, jax.random.PRNGKey(0), gen_new=False,
+                              **kw)
+        return db, jnp.stack([s1])
+
+    return jax.jit(block, donate_argnums=0), init, drain

@@ -98,19 +98,22 @@ def total_balance(stacked: smallbank.Shard, replica: int = 0):
     return sav.sum(dtype=I32) + chk.sum(dtype=I32)
 
 
-def gen_cohort(key, w: int, n_accounts: int):
+def gen_cohort(key, w: int, n_accounts: int, hot_frac: float = wl.SB_HOT_FRAC,
+               hot_prob: float = wl.SB_HOT_PROB, mix=None):
     """On-device workload generation: (ttype [w], a1 [w], a2 [w]).
 
     Hot-set skew per smallbank/caladan/smallbank.h:29-50: 90% of samples in
-    the first 4% of the keyspace."""
+    the first 4% of the keyspace (skew/mix overridable for sweep ablations)."""
     k1, k2, k3, k4, k5 = jax.random.split(key, 5)
-    ttype = jax.random.choice(k1, 6, shape=(w,), p=jnp.asarray(wl.SB_MIX))
-    hot_n = max(int(n_accounts * wl.SB_HOT_FRAC), 1)
+    ttype = jax.random.choice(
+        k1, 6, shape=(w,),
+        p=jnp.asarray(wl.SB_MIX if mix is None else mix))
+    hot_n = max(int(n_accounts * hot_frac), 1)
 
     def sample(kh, ku, kc):
         hot = jax.random.randint(kh, (w,), 0, hot_n, dtype=I32)
         uni = jax.random.randint(ku, (w,), 0, n_accounts, dtype=I32)
-        is_hot = jax.random.uniform(kc, (w,)) < wl.SB_HOT_PROB
+        is_hot = jax.random.uniform(kc, (w,)) < hot_prob
         return jnp.where(is_hot, hot, uni)
 
     ka, kb = jax.random.split(k2)
@@ -155,6 +158,52 @@ def _lock_slots(ttype, a1, a2):
     tbl = jnp.stack([tb0, tb1, tb2], axis=1)
     acc = jnp.stack([ac0, ac1, ac2], axis=1)
     return ops, tbl, acc
+
+
+def compute_phase(ttype, bal, alive, ts_amt):
+    """Per-txn-type balance logic, shared by every SmallBank engine
+    (client_ebpf_shard.cc TxnAmalgamate:255 / TxnSendPayment:830 /
+    TxnTransactSaving:1116 / TxnWriteCheck:1241 compute steps).
+
+    bal [w, L] are the fused-read balances of the txn's lock slots.
+    Returns (nw [w, L] new balances, do [w, L] slots written,
+    logic_abort [w], commit [w] writes-install, committed [w])."""
+    w, _ = bal.shape
+    t = ttype
+    b0, b1, b2 = bal[:, 0], bal[:, 1], bal[:, 2]
+    nw = jnp.zeros((w, L), I32)
+    do = jnp.zeros((w, L), bool)
+    logic_abort = jnp.zeros((w,), bool)
+
+    m = alive & (t == wl.SB_AMALGAMATE)
+    nw = nw.at[:, 2].set(jnp.where(m, b2 + b0 + b1, nw[:, 2]))
+    do = do | (m[:, None] & jnp.ones((1, L), bool))
+    m = alive & (t == wl.SB_DEPOSIT)
+    nw = nw.at[:, 0].set(jnp.where(m, b0 + AMT, nw[:, 0]))
+    do = do.at[:, 0].set(do[:, 0] | m)
+    m = alive & (t == wl.SB_SEND_PAYMENT)
+    insufficient = b0 < AMT
+    logic_abort |= m & insufficient
+    ok = m & ~insufficient
+    nw = nw.at[:, 0].set(jnp.where(ok, b0 - AMT, nw[:, 0]))
+    nw = nw.at[:, 1].set(jnp.where(ok, b1 + AMT, nw[:, 1]))
+    do = do.at[:, 0].set(do[:, 0] | ok)
+    do = do.at[:, 1].set(do[:, 1] | ok)
+    m = alive & (t == wl.SB_TRANSACT_SAVING)
+    neg = (b0 + ts_amt) < 0
+    logic_abort |= m & neg
+    ok = m & ~neg
+    nw = nw.at[:, 0].set(jnp.where(ok, b0 + ts_amt, nw[:, 0]))
+    do = do.at[:, 0].set(do[:, 0] | ok)
+    m = alive & (t == wl.SB_WRITE_CHECK)
+    overdraw = (b0 + b1) < AMT
+    nw = nw.at[:, 1].set(jnp.where(
+        m, b1 - AMT - jnp.where(overdraw, 1, 0), nw[:, 1]))
+    do = do.at[:, 1].set(do[:, 1] | m)
+
+    commit = alive & ~logic_abort & (t != wl.SB_BALANCE)
+    committed = commit | (alive & (t == wl.SB_BALANCE))
+    return nw, do, logic_abort, commit, committed
 
 
 def _broadcast_batch(op_s, table, key_lo, val, ver):
@@ -212,41 +261,8 @@ def cohort_step(stacked: smallbank.Shard, key, *, w: int, n_accounts: int):
 
     bal = jnp.where(granted, rv1[:, 0].reshape(w, L).astype(I32), 0)  # [w, L]
 
-    # ---- compute phase (client_ebpf_shard.cc balance logic per txn type) ---
-    t = ttype
-    b0, b1, b2 = bal[:, 0], bal[:, 1], bal[:, 2]
-    nw = jnp.zeros((w, L), I32)
-    do = jnp.zeros((w, L), bool)
-    logic_abort = jnp.zeros((w,), bool)
-
-    m = alive & (t == wl.SB_AMALGAMATE)
-    nw = nw.at[:, 2].set(jnp.where(m, b2 + b0 + b1, nw[:, 2]))
-    do = do | (m[:, None] & jnp.ones((1, L), bool))
-    m = alive & (t == wl.SB_DEPOSIT)
-    nw = nw.at[:, 0].set(jnp.where(m, b0 + AMT, nw[:, 0]))
-    do = do.at[:, 0].set(do[:, 0] | m)
-    m = alive & (t == wl.SB_SEND_PAYMENT)
-    insufficient = b0 < AMT
-    logic_abort |= m & insufficient
-    ok = m & ~insufficient
-    nw = nw.at[:, 0].set(jnp.where(ok, b0 - AMT, nw[:, 0]))
-    nw = nw.at[:, 1].set(jnp.where(ok, b1 + AMT, nw[:, 1]))
-    do = do.at[:, 0].set(do[:, 0] | ok)
-    do = do.at[:, 1].set(do[:, 1] | ok)
-    m = alive & (t == wl.SB_TRANSACT_SAVING)
-    neg = (b0 + ts_amt) < 0
-    logic_abort |= m & neg
-    ok = m & ~neg
-    nw = nw.at[:, 0].set(jnp.where(ok, b0 + ts_amt, nw[:, 0]))
-    do = do.at[:, 0].set(do[:, 0] | ok)
-    m = alive & (t == wl.SB_WRITE_CHECK)
-    overdraw = (b0 + b1) < AMT
-    nw = nw.at[:, 1].set(jnp.where(
-        m, b1 - AMT - jnp.where(overdraw, 1, 0), nw[:, 1]))
-    do = do.at[:, 1].set(do[:, 1] | m)
-
-    commit = alive & ~logic_abort & (t != wl.SB_BALANCE)
-    committed = commit | (alive & (t == wl.SB_BALANCE))
+    nw, do, logic_abort, commit, committed = compute_phase(
+        ttype, bal, alive, ts_amt)
     do_write = do & commit[:, None] & active          # [w, L]
     bal_delta = jnp.sum(jnp.where(do_write, nw - bal, 0), dtype=I32)
 
