@@ -139,15 +139,9 @@ def _child_main():
                            "bad VAL replies (table corruption)")
 
     # latency at cohort granularity: each cohort's txns complete 3 pipeline
-    # steps after dispatch (wave1 -> validate -> commit); a steady-state
-    # block of BLOCK steps takes block_s, so per-txn latency = 3 steps.
-    # Drop the first sample (dispatch-only, async) and the last (run_window
-    # folds the final queue-drain fetch into it, ~2x a steady-state block).
-    steady = block_s[1:-1] if len(block_s) > 2 else block_s
-    lat = st.LatencyReservoir()
-    for b in steady:
-        lat.add(np.full(BLOCK, 3.0 * b / BLOCK * 1e6))
-    p = lat.percentiles()
+    # steps after dispatch (wave1 -> validate -> commit)
+    steady = st.steady_blocks(block_s)
+    p = st.cohort_latency_percentiles(block_s, BLOCK, depth=3)
 
     out = {
         "metric": "tatp_committed_txns_per_sec",

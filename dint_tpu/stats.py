@@ -116,6 +116,24 @@ class LatencyReservoir:
                     p999=float(p999))
 
 
+def steady_blocks(block_s):
+    """Trim run_window's block-time samples to steady state: the first is
+    dispatch-only (async) and the last folds in the final queue-drain fetch
+    (~2x a steady block)."""
+    return block_s[1:-1] if len(block_s) > 2 else block_s
+
+
+def cohort_latency_percentiles(block_s, cohorts_per_block: int, depth: int):
+    """Latency percentiles at cohort granularity from per-block wall times:
+    a txn completes `depth` pipeline steps after its cohort's dispatch, and
+    a steady block of cohorts_per_block steps takes block_s seconds."""
+    lat = LatencyReservoir()
+    for b in steady_blocks(block_s):
+        lat.add(np.full(cohorts_per_block,
+                        depth * b / cohorts_per_block * 1e6))
+    return lat.percentiles()
+
+
 def run_window(runner, state, key, window_s: float, n_stats: int,
                warmup_blocks: int = 1):
     """Timed measurement loop shared by the device-fused pipeline benches.

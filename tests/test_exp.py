@@ -1,0 +1,37 @@
+"""L6 sweep driver: structural smoke over the quick TATP sweep."""
+import json
+import os
+
+import exp
+
+
+def test_quick_tatp_sweep(tmp_path):
+    out = str(tmp_path / "res")
+    results = exp.run_all(out, window_s=0.4, quick=True, only="tatp")
+
+    names = sorted(results)
+    assert any(n.startswith("tatp_closed_w") for n in names)
+    assert any(n.startswith("tatp_open_") for n in names)
+
+    for name, block in results.items():
+        # every point carries the reference metric contract
+        for field in ("throughput", "goodput", "abort_rate", "avg_us",
+                      "p50_us", "p99_us", "p999_us"):
+            assert field in block, (name, field)
+        assert block["goodput"] > 0
+        assert block["p99_us"] >= block["p50_us"] >= 0
+        # abort breakdown travels with every TATP point
+        for field in ("ab_lock", "ab_missing", "ab_validate"):
+            assert field in block, (name, field)
+        # one JSON file per config
+        with open(os.path.join(out, f"{name}.json")) as f:
+            assert json.load(f) == block
+
+    with open(os.path.join(out, "summary.json")) as f:
+        summary = json.load(f)
+    assert sorted(summary["configs"]) == names
+
+    # open-loop points record offered vs target load
+    op = next(v for k, v in results.items() if k.startswith("tatp_open_"))
+    assert op["mode"] == "open"
+    assert op["target_rate"] > 0 and op["offered_rate"] > 0
