@@ -81,17 +81,20 @@ class Coordinator:
         if vers is None:
             vers = np.zeros(m, np.uint32)
         for s in range(N_SHARDS):
-            idx = np.nonzero(shard_of == s)[0]
-            if len(idx) == 0:
-                continue
-            assert len(idx) <= self.width, "wave exceeds batch width"
-            batch = make_batch(ops[idx], accts[idx].astype(np.uint64),
-                               vals[idx], vers=vers[idx], tables=tbls[idx],
-                               width=self.width, val_words=VW)
-            self.shards[s], rep = self._step(self.shards[s], batch)
-            rt[idx] = np.asarray(rep.rtype)[: len(idx)]
-            rv[idx] = np.asarray(rep.val)[: len(idx)]
-            rver[idx] = np.asarray(rep.ver)[: len(idx)]
+            all_idx = np.nonzero(shard_of == s)[0]
+            # skewed waves SPILL across multiple batches instead of crashing
+            # (the reference client likewise spreads over extra RTTs)
+            for start in range(0, max(len(all_idx), 1), self.width):
+                idx = all_idx[start:start + self.width]
+                if len(idx) == 0:
+                    continue
+                batch = make_batch(ops[idx], accts[idx].astype(np.uint64),
+                                   vals[idx], vers=vers[idx], tables=tbls[idx],
+                                   width=self.width, val_words=VW)
+                self.shards[s], rep = self._step(self.shards[s], batch)
+                rt[idx] = np.asarray(rep.rtype)[: len(idx)]
+                rv[idx] = np.asarray(rep.val)[: len(idx)]
+                rver[idx] = np.asarray(rep.ver)[: len(idx)]
         return rt, rv, rver
 
     # -------------------------------------------------------------- cohort

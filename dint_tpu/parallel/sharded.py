@@ -36,7 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..engines import tatp
+from ..engines import smallbank, tatp
 from ..engines.types import Batch, Op, Replies
 from ..ops import segments
 
@@ -45,6 +45,14 @@ U32 = jnp.uint32
 
 N_ROLES = 3
 SHARD_AXIS = "shard"
+
+# engine registry: step fn + how many leading table ids are dense (and so
+# need the device-local row remap). Any engine whose step is a pure
+# (state, Batch) -> (state, Replies) over dense-indexed tables can shard.
+ENGINES = {
+    "tatp": (tatp.step, tatp.N_DENSE),
+    "smallbank": (smallbank.step, 2),     # SAVINGS, CHECKING
+}
 
 
 def make_mesh(n_devices: int | None = None) -> Mesh:
@@ -74,15 +82,17 @@ def _as_backup_ops(op):
     return out
 
 
-def _remap_dense_keys(batch: Batch, n_shards: int, role: int) -> Batch:
+def _remap_dense_keys(batch: Batch, n_shards: int, role: int,
+                      n_dense: int) -> Batch:
     """Remap dense-table keys in a batch to this device's local rows."""
-    is_dense = batch.table < tatp.N_DENSE
+    is_dense = batch.table < n_dense
     lk = local_dense_key(batch.key_lo.astype(I32), n_shards, role)
     return batch.replace(key_lo=jnp.where(is_dense, lk.astype(U32), batch.key_lo))
 
 
-def replicated_step(shard: tatp.Shard, batch: Batch, *, n_shards: int):
-    """One multi-chip TATP step, called inside shard_map.
+def replicated_step(shard, batch: Batch, *, n_shards: int,
+                    step_fn=tatp.step, n_dense: int = tatp.N_DENSE):
+    """One multi-chip engine step, called inside shard_map.
 
     `batch` holds this device's primary-routed requests with GLOBAL keys.
     Builds one combined batch of [3w] lanes — primary lanes (role 0) plus
@@ -99,7 +109,7 @@ def replicated_step(shard: tatp.Shard, batch: Batch, *, n_shards: int):
     is_prim = ((batch.op == Op.COMMIT_PRIM) | (batch.op == Op.INSERT_PRIM)
                | (batch.op == Op.DELETE_PRIM))
     bck_op = _as_backup_ops(batch.op)
-    parts = [_remap_dense_keys(batch, n_shards, 0)]
+    parts = [_remap_dense_keys(batch, n_shards, 0, n_dense)]
     for off in (1, 2):
         perm = [(i, (i + off) % n_shards) for i in range(n_shards)]
         pp = functools.partial(jax.lax.ppermute, axis_name=SHARD_AXIS, perm=perm)
@@ -107,22 +117,25 @@ def replicated_step(shard: tatp.Shard, batch: Batch, *, n_shards: int):
                     key_hi=pp(batch.key_hi), key_lo=pp(batch.key_lo),
                     val=pp(batch.val), ver=pp(batch.ver))
         # received records came from the device `off` behind us -> role `off`
-        parts.append(_remap_dense_keys(fwd, n_shards, off))
+        parts.append(_remap_dense_keys(fwd, n_shards, off, n_dense))
 
     combined = jax.tree.map(lambda *xs: jnp.concatenate(xs, axis=0), *parts)
-    shard, rep = tatp.step(shard, combined)
+    shard, rep = step_fn(shard, combined)
     replies = jax.tree.map(lambda x: x[: batch.width], rep)
 
     committed = jax.lax.psum(is_prim.sum().astype(I32), SHARD_AXIS)
     return shard, replies, committed
 
 
-def build_sharded_step(mesh: Mesh, n_shards: int):
+def build_sharded_step(mesh: Mesh, n_shards: int, engine: str = "tatp"):
     """jit(shard_map(replicated_step)) over stacked per-device state.
 
     State/batch arrays carry a leading [n_shards] device axis sharded over
     the mesh; inside shard_map each device sees its own [1, ...] block.
+    `engine` picks the step fn + dense-table count from ENGINES.
     """
+    step_fn, n_dense = ENGINES[engine]
+
     def squeeze(tree):
         return jax.tree.map(lambda x: x[0], tree)
 
@@ -131,7 +144,8 @@ def build_sharded_step(mesh: Mesh, n_shards: int):
 
     def local_fn(shard_blk, batch_blk):
         shard, replies, committed = replicated_step(
-            squeeze(shard_blk), squeeze(batch_blk), n_shards=n_shards)
+            squeeze(shard_blk), squeeze(batch_blk), n_shards=n_shards,
+            step_fn=step_fn, n_dense=n_dense)
         return unsqueeze(shard), unsqueeze(replies), committed[None]
 
     fn = jax.shard_map(local_fn, mesh=mesh,
@@ -140,13 +154,7 @@ def build_sharded_step(mesh: Mesh, n_shards: int):
     return jax.jit(fn)
 
 
-def create_sharded_state(mesh: Mesh, n_shards: int, n_subscribers: int,
-                         val_words: int = 10, **kw) -> tatp.Shard:
-    """Stacked per-device TATP state, device-local table sizes, sharded
-    over the mesh (leading axis = device)."""
-    rows = local_rows(n_subscribers + 1, n_shards)
-    proto = tatp.create(rows - 1, val_words=val_words, **kw)
-
+def _shard_tree(mesh: Mesh, n_shards: int, proto):
     def stack(x):
         stacked = jnp.broadcast_to(x[None], (n_shards,) + x.shape)
         return jax.device_put(stacked, NamedSharding(mesh, P(SHARD_AXIS)))
@@ -154,22 +162,49 @@ def create_sharded_state(mesh: Mesh, n_shards: int, n_subscribers: int,
     return jax.tree.map(stack, proto)
 
 
+def create_sharded_state(mesh: Mesh, n_shards: int, n_subscribers: int,
+                         val_words: int = 10, **kw) -> tatp.Shard:
+    """Stacked per-device TATP state, device-local table sizes, sharded
+    over the mesh (leading axis = device)."""
+    rows = local_rows(n_subscribers + 1, n_shards)
+    return _shard_tree(mesh, n_shards,
+                       tatp.create(rows - 1, val_words=val_words, **kw))
+
+
+def create_sharded_smallbank(mesh: Mesh, n_shards: int, n_accounts: int,
+                             val_words: int = 2, **kw) -> smallbank.Shard:
+    """Stacked per-device SmallBank state (reference shards its 3 servers
+    identically, smallbank/caladan/client_ebpf_shard.cc:287-289)."""
+    rows = local_rows(n_accounts, n_shards)
+    return _shard_tree(mesh, n_shards,
+                       smallbank.create(rows, val_words=val_words, **kw))
+
+
 def route_batches(ops, tbls, keys, vals, vers, n_shards: int, width: int,
                   val_words: int):
-    """Host-side: bucket flat request arrays by owner = key % n_shards into a
-    stacked [n_shards, width] Batch (the reference client's per-shard batch
-    grouping, smallbank/caladan/client_ebpf_shard.cc:287-289)."""
+    """Host-side: bucket flat request arrays by owner = key % n_shards into
+    stacked [n_shards, width] Batches (the reference client's per-shard
+    batch grouping, smallbank/caladan/client_ebpf_shard.cc:287-289).
+
+    Skewed batches SPILL instead of crashing: requests beyond `width` for a
+    device carry over into further waves (the reference client likewise
+    retries over multiple RTTs rather than dying). Returns
+    (waves: list of stacked Batch, owner [n]); every request appears in
+    exactly one wave, at most `width` per device per wave."""
     from ..engines.types import make_batch
 
     owner = (np.asarray(keys, np.int64) % n_shards)
-    parts = []
-    for d in range(n_shards):
-        idx = np.nonzero(owner == d)[0]
-        assert len(idx) <= width, "per-device batch overflow"
-        parts.append(make_batch(ops[idx], keys[idx].astype(np.uint64),
-                                vals[idx] if vals is not None else None,
-                                vers=vers[idx] if vers is not None else None,
-                                tables=tbls[idx], width=width,
-                                val_words=val_words))
-    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *parts)
-    return stacked, owner
+    per_dev = [np.nonzero(owner == d)[0] for d in range(n_shards)]
+    n_waves = max(1, max((len(i) + width - 1) // width for i in per_dev))
+    waves = []
+    for wv in range(n_waves):
+        parts = []
+        for d in range(n_shards):
+            idx = per_dev[d][wv * width:(wv + 1) * width]
+            parts.append(make_batch(
+                ops[idx], keys[idx].astype(np.uint64),
+                vals[idx] if vals is not None else None,
+                vers=vers[idx] if vers is not None else None,
+                tables=tbls[idx], width=width, val_words=val_words))
+        waves.append(jax.tree.map(lambda *xs: jnp.stack(xs), *parts))
+    return waves, owner

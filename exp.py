@@ -57,24 +57,28 @@ def _percentiles(samples_us):
     return lat.percentiles()
 
 
-def pipeline_closed(run, carry, drain, n_stats, *, window_s, w, cpb,
-                    depth, key_seed=0):
+def pipeline_closed(run, carry, drain, n_stats, *, window_s, cpb,
+                    depth, magic_idx, key_seed=0):
     """Closed-loop window over a fused pipelined runner.
 
     Latency is cohort-granularity: a txn completes `depth` pipeline steps
     after its cohort's dispatch; a steady-state block of cpb steps takes
-    block_s. Returns (totals [n_stats], dt, percentiles dict)."""
+    block_s. The magic-byte integrity check covers warmup + pre-run blocks
+    too (their writes land in the same tables — same rule as bench.py).
+    Returns (totals [n_stats], dt, percentiles dict)."""
     import jax
 
     from dint_tpu import stats as st
 
     key = jax.random.PRNGKey(key_seed)
     carry, s0 = run(carry, jax.random.fold_in(key, 999_999))
-    np.asarray(s0)  # compile + sync
-    carry, total, _warm, dt, _blocks, block_s = st.run_window(
+    s0 = np.asarray(s0, np.int64).sum(axis=0)  # compile + sync
+    carry, total, warm, dt, _blocks, block_s = st.run_window(
         run, carry, key, window_s, n_stats, warmup_blocks=1)
     _, tail = drain(carry)
     total = total + np.asarray(tail, np.int64).sum(axis=0)
+    if int(s0[magic_idx] + warm[magic_idx] + total[magic_idx]) != 0:
+        raise RuntimeError("magic-byte integrity violated (incl. warmup)")
     p = st.cohort_latency_percentiles(block_s, cpb, depth)
     return total, dt, p
 
@@ -184,7 +188,7 @@ def _metric_json(att, com, dt, p, extra):
 
 
 def sweep_pipeline(name, runner_fn, extras_fn, n_stats, *, widths, cpb,
-                   depth, window_s, open_rates, results):
+                   depth, magic_idx, window_s, open_rates, results):
     """Closed-loop width sweep, then open-loop rate sweep at the widest
     width relative to its measured peak."""
     peak = None
@@ -192,8 +196,8 @@ def sweep_pipeline(name, runner_fn, extras_fn, n_stats, *, widths, cpb,
     for w in widths:
         run, carry, drain = runner_fn(w, cpb)
         total, dt, p = pipeline_closed(run, carry, drain, n_stats,
-                                       window_s=window_s, w=w, cpb=cpb,
-                                       depth=depth)
+                                       window_s=window_s, cpb=cpb,
+                                       depth=depth, magic_idx=magic_idx)
         att, com, extra = extras_fn(total)
         extra["mode"] = "closed"
         extra["width"] = w
@@ -215,48 +219,52 @@ def sweep_pipeline(name, runner_fn, extras_fn, n_stats, *, widths, cpb,
             att, com, dt, p, extra)
 
 
-def sweep_micro(window_s, quick, results):
+def sweep_micro(window_s, quick, results, want=lambda name: True):
     """store / lock_2pl / lock_fasst (+attribution) / log_server
-    microbenchmarks via their reference-parity clients."""
+    microbenchmarks via their reference-parity clients. `want` gates each
+    point BEFORE it runs (the --only filter must skip work, not discard
+    results)."""
     from dint_tpu.clients import micro, workloads as wl
 
     rng = np.random.default_rng(0)
     n_keys = 10_000 if quick else 1_000_000
     widths = [1024] if quick else [1024, 4096, 16384]
 
-    for read_frac, tag in ((0.5, "contention"), (1.0, "parallel")):
-        for w in widths:
-            c = micro.StoreClient.populated(n_keys, width=w,
-                                            read_frac=read_frac)
-            c.run_wave(rng)          # compile
-            c.rec.reset()
-            t0 = time.time()
-            while time.time() - t0 < window_s:
-                c.run_wave(rng)
-            results[f"store_{tag}_w{w}"] = c.rec.block(
-                time.time() - t0).to_dict() | {"width": w}
-
-    trace = wl.lock_trace(rng, n_txns=200 if quick else 20_000,
-                          key_range=4800)
-    for cls, name, kw in ((micro.Lock2PLClient, "lock_2pl", {}),
-                          (micro.FasstClient, "lock_fasst", {}),
-                          (micro.FasstClient, "lock_fasst_attr",
-                           {"attribute": True})):
-        c = cls(trace, cohort=64 if quick else 512, **kw)
-        c.run_round()                # compile
-        c.rec.reset()
+    def timed(name, client, go):
+        if not want(name):
+            return
+        go()                         # compile
+        client.rec.reset()
         t0 = time.time()
         while time.time() - t0 < window_s:
-            c.run_round()
-        results[name] = c.rec.block(time.time() - t0).to_dict()
+            go()
+        results[name] = client.rec.block(time.time() - t0).to_dict()
 
-    c = micro.LogClient(width=1024 if quick else 8192)
-    c.run_wave(rng)
-    c.rec.reset()
-    t0 = time.time()
-    while time.time() - t0 < window_s:
-        c.run_wave(rng)
-    results["log_server"] = c.rec.block(time.time() - t0).to_dict()
+    for read_frac, tag in ((0.5, "contention"), (1.0, "parallel")):
+        for w in widths:
+            name = f"store_{tag}_w{w}"
+            if not want(name):
+                continue
+            c = micro.StoreClient.populated(n_keys, width=w,
+                                            read_frac=read_frac)
+            timed(name, c, lambda: c.run_wave(rng))
+            results[name] = results[name] | {"width": w}
+
+    if any(want(n) for n in ("lock_2pl", "lock_fasst", "lock_fasst_attr")):
+        trace = wl.lock_trace(rng, n_txns=200 if quick else 20_000,
+                              key_range=4800)
+        for cls, name, kw in ((micro.Lock2PLClient, "lock_2pl", {}),
+                              (micro.FasstClient, "lock_fasst", {}),
+                              (micro.FasstClient, "lock_fasst_attr",
+                               {"attribute": True})):
+            if not want(name):
+                continue
+            c = cls(trace, cohort=64 if quick else 512, **kw)
+            timed(name, c, c.run_round)
+
+    if want("log_server"):
+        c = micro.LogClient(width=1024 if quick else 8192)
+        timed("log_server", c, lambda: c.run_wave(rng))
 
 
 OPEN_RATES = (0.25, 0.5, 0.75, 0.9, 1.1)
@@ -282,19 +290,16 @@ def run_all(out: str, window_s: float = 10.0, quick: bool = False,
 
         sweep_pipeline("tatp", lambda w, b: _tatp_runner(n_sub, w, b),
                        _tatp_extras, td.N_STATS, widths=widths, cpb=cpb,
-                       depth=3, window_s=window_s, open_rates=rates,
-                       results=results)
+                       depth=3, magic_idx=td.STAT_MAGIC_BAD,
+                       window_s=window_s, open_rates=rates, results=results)
     if want("smallbank"):
         from dint_tpu.engines import smallbank_dense as sd
 
         sweep_pipeline("smallbank", lambda w, b: _sb_runner(n_acc, w, b),
                        _sb_extras, sd.N_STATS, widths=widths, cpb=cpb,
-                       depth=2, window_s=window_s, open_rates=rates,
-                       results=results)
-    if any(want(n) for n in ("store", "lock_2pl", "lock_fasst", "log")):
-        micro_res: dict[str, dict] = {}
-        sweep_micro(window_s, quick, micro_res)
-        results.update({k: v for k, v in micro_res.items() if want(k)})
+                       depth=2, magic_idx=sd.STAT_MAGIC_BAD,
+                       window_s=window_s, open_rates=rates, results=results)
+    sweep_micro(window_s, quick, results, want=want)  # self-gates per point
 
     for name, block in results.items():
         with open(os.path.join(out, f"{name}.json"), "w") as f:

@@ -23,7 +23,7 @@ def test_replicated_step_8dev(rng):
     ops = np.full(m, Op.OCC_LOCK, np.int32)
     tbls = np.full(m, tatp.SUBSCRIBER, np.int32)
     width = 16
-    batch, owner = sharded.route_batches(ops, tbls, keys, None, None, n, width, VW)
+    (batch,), owner = sharded.route_batches(ops, tbls, keys, None, None, n, width, VW)
     state, replies, committed = step(state, batch)
     rt = np.asarray(replies.rtype)
     # every routed lock lane granted (fresh locks, distinct rows)
@@ -36,7 +36,7 @@ def test_replicated_step_8dev(rng):
     vals = np.zeros((m, VW), np.uint32)
     vals[:, 0] = 1234
     ops = np.full(m, Op.COMMIT_PRIM, np.int32)
-    batch, owner = sharded.route_batches(ops, tbls, keys, vals, None, n, width, VW)
+    (batch,), owner = sharded.route_batches(ops, tbls, keys, vals, None, n, width, VW)
     state, replies, committed = step(state, batch)
     assert int(committed[0]) == m  # psum'd vote count, same on every device
 
@@ -61,9 +61,77 @@ def test_route_batches_padding(rng):
     keys = np.array([0, 1, 2, 9, 10], np.int64)
     ops = np.full(5, Op.OCC_READ, np.int32)
     tbls = np.zeros(5, np.int32)
-    batch, owner = sharded.route_batches(ops, tbls, keys, None, None, 3, 8, VW)
+    (batch,), owner = sharded.route_batches(ops, tbls, keys, None, None, 3, 8, VW)
     assert batch.op.shape == (3, 8)
     # owner 0: keys 0, 9; owner 1: 1, 10; owner 2: 2
     assert list(np.asarray(batch.op).sum(axis=1)) == [2 * Op.OCC_READ,
                                                       2 * Op.OCC_READ,
                                                       Op.OCC_READ]
+
+
+def test_route_batches_spills_on_skew():
+    # adversarial skew: every key owned by device 0, 3x the batch width --
+    # must spill across waves, not crash
+    keys = np.arange(0, 72, 3, dtype=np.int64)   # 24 keys, all % 3 == 0
+    ops = np.full(24, Op.OCC_READ, np.int32)
+    tbls = np.zeros(24, np.int32)
+    waves, owner = sharded.route_batches(ops, tbls, keys, None, None, 3, 8, VW)
+    assert len(waves) == 3
+    total = sum(int((np.asarray(b.op) == Op.OCC_READ).sum()) for b in waves)
+    assert total == 24
+    for b in waves:
+        assert (np.asarray(b.op)[1:] == Op.NOP).all()   # other devices idle
+
+
+def test_sharded_smallbank_8dev(rng):
+    from dint_tpu.engines import smallbank
+
+    n = 8
+    mesh = sharded.make_mesh(n)
+    n_accounts = 64
+    state = sharded.create_sharded_smallbank(mesh, n, n_accounts, val_words=2)
+    step = sharded.build_sharded_step(mesh, n, engine="smallbank")
+
+    accts = rng.choice(np.arange(n_accounts), size=32, replace=False).astype(np.int64)
+    m = len(accts)
+    width = 16
+
+    # X-lock + fused read at primaries
+    ops = np.full(m, Op.ACQ_X_READ, np.int32)
+    tbls = np.full(m, smallbank.SAVINGS, np.int32)
+    waves, owner = sharded.route_batches(ops, tbls, accts, None, None, n,
+                                         width, 2)
+    assert len(waves) == 1
+    state, replies, _ = step(state, waves[0])
+    rt = np.asarray(replies.rtype)
+    for d in range(n):
+        cnt = int((owner == d).sum())
+        assert (rt[d, :cnt] == Reply.GRANT).all()
+
+    # commit balances at primaries (client supplies the bumped version,
+    # clients/smallbank_client.py c_ver = rver1 + 1); replication lands on
+    # both backup roles via ppermute
+    vals = np.zeros((m, 2), np.uint32)
+    vals[:, 0] = 777
+    vers = np.ones(m, np.uint32)
+    ops = np.full(m, Op.COMMIT_PRIM, np.int32)
+    waves, owner = sharded.route_batches(ops, tbls, accts, vals, vers, n,
+                                         width, 2)
+    state, replies, committed = step(state, waves[0])
+    assert int(committed[0]) == m
+
+    sav_val = np.asarray(jax.device_get(state.sav.val))  # [n, rows, 2]
+    for a in accts:
+        own = int(a % n)
+        for role in range(3):
+            dev = (own + role) % n
+            local = int(sharded.local_dense_key(a, n, role))
+            assert sav_val[dev, local, 0] == 777, (a, role)
+
+    # explicit release wave (lock -> log -> bck -> prim -> RELEASE protocol,
+    # smallbank/caladan/client_ebpf_shard.cc:389-560)
+    ops = np.full(m, Op.REL_X, np.int32)
+    waves, _ = sharded.route_batches(ops, tbls, accts, None, None, n,
+                                     width, 2)
+    state, replies, _ = step(state, waves[0])
+    assert int(np.asarray(jax.device_get(state.sav_ex)).sum()) == 0

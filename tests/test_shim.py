@@ -12,11 +12,26 @@ from dint_tpu.tables import kv, locks
 from dint_tpu.tables import log as logring
 
 
+def _warm(pump, fmt=None):
+    """Absorb the pump's first XLA compile before the test's short-timeout
+    exchanges: under full-suite CPU load the first step can take >5s, which
+    otherwise shows up as a flaky 0-reply timeout."""
+    kw = {} if fmt is None else {"fmt": fmt}
+    with ShimClient("127.0.0.1", pump.port, **kw) as c:
+        for _ in range(12):
+            r = c.exchange(np.zeros(1, np.uint8),
+                           np.array([1], np.uint64), timeout_ms=10_000)
+            if r["n"] == 1:
+                return
+    raise RuntimeError("pump did not answer warmup exchanges")
+
+
 @pytest.fixture
 def store_pump():
     table = kv.create(1 << 8, val_words=10)
     with EnginePump(STORE, store.step, table, width=256,
                     flush_us=2000).start() as p:
+        _warm(p)
         yield p
 
 
