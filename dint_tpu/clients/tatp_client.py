@@ -28,7 +28,7 @@ import numpy as np
 from .. import stats
 from ..engines import tatp
 from ..engines.types import Op, Reply, make_batch
-from ..tables import kv
+from ..tables import kv, locks
 from . import workloads as wl
 
 N_SHARDS = 3
@@ -40,6 +40,12 @@ class Stats(stats.TxnStats):
     aborted_lock: int = 0      # write-set lock rejected
     aborted_validate: int = 0  # read-set version changed
     aborted_missing: int = 0   # required row absent / insert-exists
+    # lock-attribution counters (live when the shards were built with
+    # tatp.create(attr_locks=True); the reference's instrumented client
+    # keeps the same three, tatp/caladan/client_lock.cc:62-64,768-771)
+    lock_cnt: int = 0              # OCC_LOCK lanes issued
+    reject_sharing_cnt: int = 0    # rejected by a DIFFERENT key (hash share)
+    reject_same_key_cnt: int = 0   # rejected by the SAME key (true conflict)
 
 
 def populate_shards(rng: np.random.Generator, n_subscribers: int,
@@ -113,6 +119,10 @@ class Coordinator:
         # donate the shard state: steps update tables in place in HBM instead
         # of copying the full state every call
         self._step = jax.jit(tatp.step, donate_argnums=0)
+        # attribution counters are only meaningful against attr shards
+        # (tatp.create(attr_locks=True)): the plain server cannot
+        # distinguish CF same-key conflicts from hash sharing
+        self.attr = isinstance(self.shards[0].cf_lock, locks.OCCAttrTable)
         self.stats = Stats()
 
     def _run_wave(self, ops, tbls, keys, shard_of=None, vals=None, vers=None):
@@ -206,7 +216,23 @@ class Coordinator:
         r_ver[txn_of, lane_of] = rver
 
         is_lock_lane = ops == Op.OCC_LOCK
-        lock_rejected = ((r_rt == Reply.REJECT) & is_lock_lane).any(1)
+        is_rej = (r_rt == Reply.REJECT) | (r_rt == Reply.REJECT_SAME_KEY)
+        lock_rejected = (is_rej & is_lock_lane).any(1)
+        if self.attr:
+            # attribution: dense-table row locks are EXACT, so their
+            # rejects are same-key conflicts by construction; only the
+            # hash-conflated CF lock table can reject on slot sharing,
+            # which the attr server distinguishes via REJECT_SAME_KEY
+            # (lock_kern.c:292-298)
+            is_dense_lane = tbl < T.CALL_FORWARDING
+            st.lock_cnt += int(is_lock_lane.sum())
+            st.reject_sharing_cnt += int(
+                (is_lock_lane & ~is_dense_lane
+                 & (r_rt == Reply.REJECT)).sum())
+            st.reject_same_key_cnt += int(
+                (is_lock_lane & ((r_rt == Reply.REJECT_SAME_KEY)
+                                 | (is_dense_lane
+                                    & (r_rt == Reply.REJECT)))).sum())
 
         # required-row checks
         missing = np.zeros(w, bool)

@@ -75,7 +75,12 @@ class Shard:
 
 def create(n_subscribers: int, val_words: int = 10, cf_buckets: int | None = None,
            cf_lock_slots: int | None = None, log_lanes: int = 16,
-           log_capacity: int = 1 << 20) -> Shard:
+           log_capacity: int = 1 << 20, attr_locks: bool = False) -> Shard:
+    """``attr_locks=True`` builds the lock-ATTRIBUTION variant: CF lock
+    words carry their holder's key so rejects distinguish true same-key
+    conflicts from hash-slot sharing — the reference's instrumented TATP
+    server (tatp/ebpf/lock_kern.c:12-16). Dense-table locks are exact
+    per-row, so only the hash-conflated CF table has sharing to attribute."""
     p1 = n_subscribers + 1          # ids are 1-based
     if cf_buckets is None:
         cf_buckets = max(1 << (p1 * 4).bit_length(), 16)  # ~load<=0.25 at 4 slots
@@ -91,7 +96,8 @@ def create(n_subscribers: int, val_words: int = 10, cf_buckets: int | None = Non
         ai_lock=jnp.zeros((4 * p1,), bool),
         sf_lock=jnp.zeros((4 * p1,), bool),
         cf=kv.create(cf_buckets, slots=4, val_words=val_words),
-        cf_lock=locks.create_occ(cf_lock_slots),
+        cf_lock=(locks.create_occ_attr(cf_lock_slots) if attr_locks
+                 else locks.create_occ(cf_lock_slots)),
         log=logring.create(log_lanes, log_capacity, val_words),
     )
 
@@ -212,7 +218,13 @@ def _cf_step(shard: Shard, batch: Batch):
     for o in _UNLOCK_OPS:
         lock_map[o] = Op.ABORT
     lk_ops = _translate(batch.op, batch.table, lock_map)
-    new_cf_lock, lk_rep = fasst.step(shard.cf_lock, batch.replace(op=lk_ops))
+    # static dispatch on the shard's lock-table flavor (tatp.create
+    # attr_locks): the attribution variant reports REJECT_SAME_KEY vs
+    # plain REJECT on conflicts (tatp/ebpf/lock_kern.c:292-298)
+    lock_step = (fasst.step_attr
+                 if isinstance(shard.cf_lock, locks.OCCAttrTable)
+                 else fasst.step)
+    new_cf_lock, lk_rep = lock_step(shard.cf_lock, batch.replace(op=lk_ops))
     shard = shard.replace(cf=new_cf, cf_lock=new_cf_lock)
     # lock replies only for OCC_LOCK lanes; everything else from the KV view
     use_lock = (batch.table == CALL_FORWARDING) & (batch.op == Op.OCC_LOCK)

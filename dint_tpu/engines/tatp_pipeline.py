@@ -375,7 +375,8 @@ def classify_wave1(ttype, rt, ops, ws_active, ws_lane):
 
     ws_rt = jnp.take_along_axis(rt, ws_lane, axis=1)
     granted = ws_active & (ws_rt == Reply.GRANT)
-    lock_rejected = (ws_active & (ws_rt == Reply.REJECT)).any(axis=1)
+    rejected = (ws_rt == Reply.REJECT) | (ws_rt == Reply.REJECT_SAME_KEY)
+    lock_rejected = (ws_active & rejected).any(axis=1)
 
     missing = jnp.zeros(t.shape, bool)
     m = t == wl.TATP_GET_NEW_DEST
