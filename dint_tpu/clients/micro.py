@@ -39,6 +39,20 @@ from . import workloads as wl
 STORE_MAGIC = 0x55AA
 
 
+def make_store_table(n_keys: int, *, n_buckets: int | None = None,
+                     val_words: int = 10) -> kv.KVTable:
+    """Populated store table: keys 1..n, val word0 = key, word1 = magic
+    (store/caladan/client_caladan.cc:160). Shared by the in-process store
+    client and the wire-path bench so both serve identical contents."""
+    if n_buckets is None:
+        n_buckets = max(16, 1 << int(np.ceil(np.log2(n_keys / 2))))
+    keys = np.arange(1, n_keys + 1, dtype=np.uint64)
+    vals = np.zeros((n_keys, val_words), np.uint32)
+    vals[:, 0] = keys.astype(np.uint32)
+    vals[:, 1] = STORE_MAGIC
+    return kv.populate(kv.create(n_buckets, val_words=val_words), keys, vals)
+
+
 class _SteppedClient:
     """Shared plumbing: jitted donated step + timed wave runner."""
 
@@ -77,13 +91,8 @@ class StoreClient(_SteppedClient):
     @classmethod
     def populated(cls, n_keys: int, *, n_buckets: int | None = None,
                   val_words: int = 10, **kw):
-        if n_buckets is None:
-            n_buckets = max(16, 1 << int(np.ceil(np.log2(n_keys / 2))))
-        keys = np.arange(1, n_keys + 1, dtype=np.uint64)
-        vals = np.zeros((n_keys, val_words), np.uint32)
-        vals[:, 0] = keys.astype(np.uint32)
-        vals[:, 1] = STORE_MAGIC
-        table = kv.populate(kv.create(n_buckets, val_words=val_words), keys, vals)
+        table = make_store_table(n_keys, n_buckets=n_buckets,
+                                 val_words=val_words)
         return cls(table, n_keys, val_words=val_words, **kw)
 
     def run_wave(self, rng: np.random.Generator, n: int | None = None):

@@ -5,9 +5,14 @@ pump (native/shim.cc) accumulates reference-wire-format datagrams into a
 fixed-width batch, this class translates wire codes -> engine ops
 (shim.wire profiles), pads to the jitted step's static width, runs the
 step, translates Reply codes back, and hands the reply arrays to C++ for
-sendmmsg scatter. One thread; the jitted step overlaps with C++ RX
-batching naturally (the RX thread fills the next ring slot while the
-device runs).
+sendmmsg scatter.
+
+The serve loop is DOUBLE-BUFFERED over the shim's 8-slot ready ring:
+batch i is dispatched (async jax step) before batch i-1's replies are
+fetched and serialized, so device execution of i overlaps both the C++ RX
+batching of i+1 and the host-side reply scatter of i-1 — the wire-path
+analogue of the reference's run-to-completion prefetch pipeline
+(tatp/dpdk/server_shard.cc:999-1016).
 """
 from __future__ import annotations
 
@@ -38,11 +43,11 @@ class EnginePump:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
-    def serve_one(self, timeout_us: int = 100_000) -> bool:
-        """Poll one batch, certify, reply. Returns True if a batch ran."""
-        got = self.server.poll(timeout_us)
-        if got is None:
-            return False
+    def _dispatch(self, got):
+        """Parse a polled batch and dispatch the jitted step (async).
+        The C++ ring slot's views are fully consumed here (make_batch
+        copies to device buffers), so only the slot id + reply metadata
+        survive. Returns a pending record for _finish."""
         slot, b = got
         n = len(b["key"])
         wire_type = b["type"].copy()  # views die at reply(); copy what we keep
@@ -53,6 +58,12 @@ class EnginePump:
                            tables=b["table"].astype(np.int32),
                            width=self.width, val_words=self.val_words)
         self.state, replies = self._step(self.state, batch)
+        return slot, n, wire_type, replies
+
+    def _finish(self, pending):
+        """Fetch a dispatched batch's replies (value fetch = sync) and
+        scatter them back over the wire."""
+        slot, n, wire_type, replies = pending
         rtype = np.asarray(replies.rtype)[:n]
         rval32 = np.asarray(replies.val)[:n]
         rver = np.asarray(replies.ver)[:n]
@@ -62,11 +73,32 @@ class EnginePump:
             rval32[:, :self.val_words]).view(np.uint8).reshape(n, -1)
         self.server.reply(slot, wire_reply, rval, rver)
         self.batches_served += 1
+
+    def serve_one(self, timeout_us: int = 100_000) -> bool:
+        """Poll one batch, certify, reply (synchronous single-batch path).
+        Returns True if a batch ran."""
+        got = self.server.poll(timeout_us)
+        if got is None:
+            return False
+        self._finish(self._dispatch(got))
         return True
 
     def serve_forever(self):
+        """Double-buffered loop: dispatch batch i, then finish batch i-1.
+        The poll is NON-blocking while a batch is in flight — if the ring
+        has a follow-up batch ready it pipelines, otherwise the pending
+        replies go out immediately (closed-loop clients are blocked on
+        them, so waiting here would just add dead reply latency)."""
+        pending = None
         while not self._stop.is_set():
-            self.serve_one(timeout_us=50_000)
+            got = self.server.poll(
+                timeout_us=0 if pending is not None else 50_000)
+            new = self._dispatch(got) if got is not None else None
+            if pending is not None:
+                self._finish(pending)
+            pending = new
+        if pending is not None:
+            self._finish(pending)
 
     def start(self):
         """Run the serve loop on a background thread (tests/benchmarks)."""

@@ -266,6 +266,85 @@ def sweep_micro(window_s, quick, results, want=lambda name: True):
         c = micro.LogClient(width=1024 if quick else 8192)
         timed("log_server", c, lambda: c.run_wave(rng))
 
+    if want("store_wire"):
+        results["store_wire"] = _store_wire_bench(window_s, quick)
+
+
+def _store_wire_bench(window_s, quick):
+    """store served OVER THE WIRE: reference-wire-format UDP datagrams
+    through the native C++ pump (recvmmsg batch -> jitted store.step ->
+    sendmmsg scatter, double-buffered), measured in pkt/s from concurrent
+    loopback clients — the TPU analogue of the reference's store server
+    benchmark (store/udp/server.cc:50-98; server pps counter,
+    store/ebpf/store_user.c:58-65)."""
+    import threading
+
+    from dint_tpu.clients.micro import make_store_table
+    from dint_tpu.engines import store
+    from dint_tpu.shim import STORE, EnginePump, ShimClient
+    from dint_tpu.stats import LatencyReservoir, MetricBlock
+
+    n_keys = 4_096 if quick else 200_000
+    width = 1_024 if quick else 4_096
+    n_clients = 2
+    wave = width // n_clients
+
+    table = make_store_table(n_keys)
+
+    with EnginePump(STORE, store.step, table, width=width,
+                    flush_us=500).start() as pump:
+        with ShimClient("127.0.0.1", pump.port) as c:     # warm past compile
+            for attempt in range(8):
+                if c.exchange(np.zeros(1, np.uint8),
+                              np.array([1], np.uint64),
+                              timeout_ms=20_000)["n"] == 1:
+                    break
+            else:
+                raise RuntimeError(
+                    "store_wire pump answered no warmup exchange in 8 "
+                    "attempts — refusing to publish a compile-polluted "
+                    "measurement")
+
+        stop_at = time.time() + window_s
+        sent = np.zeros(n_clients, np.int64)
+        answered = np.zeros(n_clients, np.int64)
+        lats = [LatencyReservoir(seed=i) for i in range(n_clients)]
+
+        def worker(i):
+            rng = np.random.default_rng(i)
+            with ShimClient("127.0.0.1", pump.port) as c:
+                while time.time() < stop_at:
+                    k = rng.integers(1, n_keys + 1, size=wave).astype(np.uint64)
+                    is_read = rng.random(wave) < 0.5     # contention mix
+                    t0 = time.monotonic()
+                    r = c.exchange(np.where(is_read, 0, 1).astype(np.uint8),
+                                   k, timeout_ms=10_000)
+                    dt = time.monotonic() - t0
+                    sent[i] += wave
+                    answered[i] += r["n"]
+                    lats[i].add(np.full(r["n"], dt * 1e6))
+
+        t0 = time.time()
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(n_clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.time() - t0
+
+    agg = LatencyReservoir()
+    for lr in lats:
+        agg.add(lr.samples[:lr.n_kept])
+    p = agg.percentiles()
+    return MetricBlock(
+        throughput=float(sent.sum()) / dt,
+        goodput=float(answered.sum()) / dt,
+        avg_us=p["avg"], p50_us=p["p50"], p99_us=p["p99"],
+        p999_us=p["p999"],
+        extra={"unit": "pkt/s", "clients": n_clients, "wave": wave,
+               "transport": "udp_loopback_shim"}).to_dict()
+
 
 OPEN_RATES = (0.25, 0.5, 0.75, 0.9, 1.1)
 
