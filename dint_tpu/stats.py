@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import time
 
 import numpy as np
@@ -114,6 +115,48 @@ class LatencyReservoir:
         p50, p99, p999 = np.percentile(s, [50, 99, 99.9])
         return dict(avg=float(s.mean()), p50=float(p50), p99=float(p99),
                     p999=float(p999))
+
+
+class CpuMonitor:
+    """Independent host-utilization measurement, the reference's cpu_util
+    service (smallbank/cpu_util.h:37-46: user vs kernel core-seconds from
+    /proc/stat over the measurement window, printed as `primary
+    ucores/kcores` in every client's final stats). Machine-wide AND
+    process-level (this process = the host shim + dispatch loop, the TPU
+    analogue of the reference's 16 server worker cores)."""
+
+    def __init__(self):
+        self._t0 = time.monotonic()
+        self._m0 = self._machine()
+        self._p0 = self._process()
+
+    @staticmethod
+    def _machine():
+        with open("/proc/stat") as f:
+            parts = f.readline().split()
+        # user, nice, system, idle, iowait, irq, softirq
+        user = int(parts[1]) + int(parts[2])
+        kernel = int(parts[3]) + int(parts[6]) + int(parts[7])
+        return user, kernel
+
+    @staticmethod
+    def _process():
+        with open("/proc/self/stat") as f:
+            parts = f.read().rsplit(") ", 1)[1].split()
+        return int(parts[11]), int(parts[12])   # utime, stime
+
+    def cores(self) -> dict:
+        """Core-equivalents busy since construction (jiffies / HZ / wall)."""
+        hz = float(os.sysconf("SC_CLK_TCK"))
+        dt = max(time.monotonic() - self._t0, 1e-9)
+        m1 = self._machine()
+        p1 = self._process()
+        return {
+            "host_ucores": round((m1[0] - self._m0[0]) / hz / dt, 3),
+            "host_kcores": round((m1[1] - self._m0[1]) / hz / dt, 3),
+            "proc_ucores": round((p1[0] - self._p0[0]) / hz / dt, 3),
+            "proc_kcores": round((p1[1] - self._p0[1]) / hz / dt, 3),
+        }
 
 
 def steady_blocks(block_s):

@@ -65,22 +65,26 @@ def pipeline_closed(run, carry, drain, n_stats, *, window_s, cpb,
     after its cohort's dispatch; a steady-state block of cpb steps takes
     block_s. The magic-byte integrity check covers warmup + pre-run blocks
     too (their writes land in the same tables — same rule as bench.py).
-    Returns (totals [n_stats], dt, percentiles dict)."""
+    Returns (totals [n_stats], dt, percentiles dict, host cores dict)."""
     import jax
 
     from dint_tpu import stats as st
 
     key = jax.random.PRNGKey(key_seed)
-    carry, s0 = run(carry, jax.random.fold_in(key, 999_999))
-    s0 = np.asarray(s0, np.int64).sum(axis=0)  # compile + sync
+    s0 = np.zeros(n_stats, np.int64)
+    for warm_key in (999_999, 999_998):   # fresh + donated-carry layouts
+        carry, s = run(carry, jax.random.fold_in(key, warm_key))
+        s0 += np.asarray(s, np.int64).sum(axis=0)  # fetch = sync
+    cpu = st.CpuMonitor()   # strictly over the timed window
     carry, total, warm, dt, _blocks, block_s = st.run_window(
-        run, carry, key, window_s, n_stats, warmup_blocks=1)
+        run, carry, key, window_s, n_stats, warmup_blocks=0)
+    cores = cpu.cores()
     _, tail = drain(carry)
     total = total + np.asarray(tail, np.int64).sum(axis=0)
     if int(s0[magic_idx] + warm[magic_idx] + total[magic_idx]) != 0:
         raise RuntimeError("magic-byte integrity violated (incl. warmup)")
     p = st.cohort_latency_percentiles(block_s, cpb, depth)
-    return total, dt, p
+    return total, dt, p, cores
 
 
 def pipeline_open(make_runner, n_stats, *, rate, window_s, w, cpb, depth,
@@ -195,10 +199,12 @@ def sweep_pipeline(name, runner_fn, extras_fn, n_stats, *, widths, cpb,
     peak_w = None
     for w in widths:
         run, carry, drain = runner_fn(w, cpb)
-        total, dt, p = pipeline_closed(run, carry, drain, n_stats,
-                                       window_s=window_s, cpb=cpb,
-                                       depth=depth, magic_idx=magic_idx)
+        total, dt, p, cores = pipeline_closed(run, carry, drain, n_stats,
+                                              window_s=window_s, cpb=cpb,
+                                              depth=depth,
+                                              magic_idx=magic_idx)
         att, com, extra = extras_fn(total)
+        extra.update(cores)
         extra["mode"] = "closed"
         extra["width"] = w
         results[f"{name}_closed_w{w}"] = _metric_json(att, com, dt, p, extra)
@@ -362,7 +368,10 @@ def run_all(out: str, window_s: float = 10.0, quick: bool = False,
     rates = OPEN_RATES[1::2] if quick else OPEN_RATES
 
     def want(name):
-        return only is None or only in name
+        # bidirectional substring: --only tatp matches point tatp_closed_w256
+        # via `only in name`; --only tatp_closed passes the coarse `tatp`
+        # gate via `name in only`
+        return only is None or only in name or name in only
 
     if want("tatp"):
         from dint_tpu.engines import tatp_dense as td

@@ -129,3 +129,23 @@ def test_scatter_rows_masked():
     assert np.array_equal(out[3], [9, 9])  # only the masked-in writer landed
     assert np.array_equal(out[7], [4, 4])
     assert out.sum() == 28
+
+
+def test_cpu_monitor_measures_busy_host():
+    import time
+
+    from dint_tpu.stats import CpuMonitor
+
+    mon = CpuMonitor()
+    t0 = time.time()
+    x = 0
+    while time.time() - t0 < 0.4:    # burn user cpu
+        x += sum(range(1000))
+    cores = mon.cores()
+    assert set(cores) == {"host_ucores", "host_kcores", "proc_ucores",
+                          "proc_kcores"}
+    # ~1 user core nominally; generous floor for loaded/quota'd runners
+    assert cores["proc_ucores"] > 0.1
+    assert cores["host_ucores"] >= cores["proc_ucores"] - 0.2
+    for v in cores.values():
+        assert v >= 0
