@@ -1,0 +1,114 @@
+"""Failure recovery: rebuild engine state by replaying the replication log.
+
+The reference writes every certified mutation to per-CPU log rings BEFORE
+backup/primary commit (log_server/ebpf/ls_kern.c:63-77; CommitLog x3 in the
+commit pipeline, client_ebpf_shard.cc:779-810) — write-ahead durability
+that is never replayed in-code (SURVEY.md §5.3/5.4: no failover, no
+recovery-from-log). This module closes that gap for the TPU engines: a
+replica that lost its tables can be rebuilt from a base snapshot + any one
+surviving log ring, because versions are monotonic per row — the
+highest-versioned log entry per row IS the row's final state.
+
+Recovery is a host-side (numpy) path: it is not a hot loop, and the log
+rings fetch as plain arrays.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .tables.log import HDR_WORDS
+
+
+def _flat_entries(entries: np.ndarray, heads: np.ndarray):
+    """Live entries of a multi-lane ring, as flat arrays.
+
+    entries [L, CAP, HDR+VW] u32, heads [L] u32 (monotonic; ring wraps) ->
+    (flags, key_lo, ver, val [n, VW]) of every written slot."""
+    lanes, cap, _ = entries.shape
+    if (heads.astype(np.int64) > cap).any():
+        # the ring wrapped: oldest entries were overwritten, so a row whose
+        # only log records were evicted is unrecoverable — same bounded
+        # durability as the reference's fixed rings (ls_kern.c:72-73)
+        raise ValueError("log ring wrapped: recovery window exceeded "
+                         f"(head max {int(heads.max())} > capacity {cap})")
+    counts = np.minimum(heads.astype(np.int64), cap)
+    lane_of = np.repeat(np.arange(lanes), counts)
+    slot_of = np.concatenate([np.arange(c) for c in counts]) \
+        if counts.sum() else np.zeros(0, np.int64)
+    e = entries[lane_of, slot_of]
+    return e[:, 0], e[:, 2], e[:, 3], e[:, HDR_WORDS:]
+
+
+def latest_per_row(rows: np.ndarray, vers: np.ndarray):
+    """Index of the max-version entry per distinct row (monotonic versions
+    make this the row's final logged state). Returns (row_ids, idx)."""
+    if len(rows) == 0:
+        return rows, np.zeros(0, np.int64)
+    order = np.lexsort((vers, rows))
+    sr = rows[order]
+    last = np.r_[sr[1:] != sr[:-1], True]
+    return sr[last], order[last]
+
+
+def recover_tatp_dense(db0, log_entries, log_heads):
+    """Rebuild a tatp_dense.DenseDB's table state from a base snapshot +
+    ONE replica's log ring (entries/heads as numpy arrays).
+
+    db0 is the pre-run populated state (the reference's populate step) and
+    fixes the table geometry; the returned DenseDB has val/ver/exists
+    equal to the post-run state for every logged row. Locks are volatile
+    (a recovering replica restarts with a free lock table, like the
+    reference's fresh server)."""
+    import jax.numpy as jnp
+
+    from .engines import tatp_dense as td
+
+    n_sub = int(db0.n_sub)
+    flags, key_lo, vers, vals = _flat_entries(np.asarray(log_entries),
+                                              np.asarray(log_heads))
+    is_del = (flags & 0xFF).astype(bool)
+    table = (flags >> 8).astype(np.int64)
+    base = td._bases(n_sub + 1).astype(np.int64)
+    rows = base[table] + key_lo.astype(np.int64)
+
+    urows, idx = latest_per_row(rows, vers)
+    n_sub_rows = td.n_rows(n_sub) + 1
+    if not (urows < n_sub_rows - 1).all():
+        raise ValueError("log row out of table range: the log belongs to "
+                         "a different-geometry database than db0")
+
+    val = np.array(db0.val)
+    ver = np.array(db0.ver)
+    exists = np.array(db0.exists)
+    vw = val.shape[2]
+    val[urows] = vals[idx][:, None, :vw]
+    ver[urows] = vers[idx][:, None]
+    exists[urows] = ~is_del[idx][:, None]
+    return db0.replace(val=jnp.asarray(val), ver=jnp.asarray(ver),
+                       exists=jnp.asarray(exists),
+                       locked=jnp.zeros_like(db0.locked))
+
+
+def recover_smallbank_dense(db0, log_entries, log_heads):
+    """Same for smallbank_dense.DenseBank (no deletes in SmallBank);
+    db0 fixes the table geometry."""
+    import jax.numpy as jnp
+
+    n_accounts = int(db0.n_accounts)
+    flags, key_lo, vers, vals = _flat_entries(np.asarray(log_entries),
+                                              np.asarray(log_heads))
+    table = (flags >> 8).astype(np.int64)
+    rows = table * n_accounts + key_lo.astype(np.int64)
+
+    urows, idx = latest_per_row(rows, vers)
+    if not (urows < 2 * n_accounts).all():
+        raise ValueError("log row out of table range: the log belongs to "
+                         "a different-geometry database than db0")
+    val = np.array(db0.val)
+    ver = np.array(db0.ver)
+    vw = val.shape[2]
+    val[urows] = vals[idx][:, None, :vw]
+    ver[urows] = vers[idx][:, None]
+    return db0.replace(val=jnp.asarray(val), ver=jnp.asarray(ver),
+                       x_held=jnp.zeros_like(db0.x_held),
+                       s_count=jnp.zeros_like(db0.s_count))
