@@ -33,8 +33,7 @@ def _flat_entries(entries: np.ndarray, heads: np.ndarray):
                          f"(head max {int(heads.max())} > capacity {cap})")
     counts = np.minimum(heads.astype(np.int64), cap)
     lane_of = np.repeat(np.arange(lanes), counts)
-    slot_of = np.concatenate([np.arange(c) for c in counts]) \
-        if counts.sum() else np.zeros(0, np.int64)
+    slot_of = np.concatenate([np.arange(c) for c in counts])
     e = entries[lane_of, slot_of]
     return e[:, 0], e[:, 2], e[:, 3], e[:, HDR_WORDS:]
 
@@ -68,14 +67,16 @@ def recover_tatp_dense(db0, log_entries, log_heads):
                                               np.asarray(log_heads))
     is_del = (flags & 0xFF).astype(bool)
     table = (flags >> 8).astype(np.int64)
-    base = td._bases(n_sub + 1).astype(np.int64)
+    p1 = n_sub + 1
+    sizes = np.array([p1, p1, 4 * p1, 4 * p1, 12 * p1], np.int64)
+    if not ((table < 5) & (key_lo.astype(np.int64)
+                           < sizes[np.minimum(table, 4)])).all():
+        raise ValueError("log key out of its table's range: the log "
+                         "belongs to a different-geometry database than db0")
+    base = td._bases(p1).astype(np.int64)
     rows = base[table] + key_lo.astype(np.int64)
 
     urows, idx = latest_per_row(rows, vers)
-    n_sub_rows = td.n_rows(n_sub) + 1
-    if not (urows < n_sub_rows - 1).all():
-        raise ValueError("log row out of table range: the log belongs to "
-                         "a different-geometry database than db0")
 
     val = np.array(db0.val)
     ver = np.array(db0.ver)
@@ -98,12 +99,12 @@ def recover_smallbank_dense(db0, log_entries, log_heads):
     flags, key_lo, vers, vals = _flat_entries(np.asarray(log_entries),
                                               np.asarray(log_heads))
     table = (flags >> 8).astype(np.int64)
+    if not ((table < 2) & (key_lo.astype(np.int64) < n_accounts)).all():
+        raise ValueError("log key out of its table's range: the log "
+                         "belongs to a different-geometry database than db0")
     rows = table * n_accounts + key_lo.astype(np.int64)
 
     urows, idx = latest_per_row(rows, vers)
-    if not (urows < 2 * n_accounts).all():
-        raise ValueError("log row out of table range: the log belongs to "
-                         "a different-geometry database than db0")
     val = np.array(db0.val)
     ver = np.array(db0.ver)
     vw = val.shape[2]

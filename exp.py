@@ -275,6 +275,69 @@ def sweep_micro(window_s, quick, results, want=lambda name: True):
     if want("store_wire"):
         results["store_wire"] = _store_wire_bench(window_s, quick)
 
+    for tag in ("wb_bloom", "wb_nobloom", "wt"):
+        name = f"store_cached_{tag}"
+        if want(name):
+            results[name] = _store_cached_bench(tag, window_s, quick)
+
+
+def _store_cached_bench(tag, window_s, quick):
+    """Two-tier cached store (device cache + host KVS): the reference's
+    store-server ablation matrix — write-back + bloom vs write-back without
+    bloom vs write-through (store/ebpf/store_kern.c vs store_wb_kern.c vs
+    store_wt_kern.c). Keyspace is ~2x the cache capacity so the miss/refill
+    path is live; extras report the hit/miss/bloom split."""
+    from dint_tpu.clients.micro import STORE_MAGIC
+    from dint_tpu.engines import store_cache
+    from dint_tpu.engines.types import Op
+    from dint_tpu.shim.host_kvs import CachedStore
+    from dint_tpu.stats import Recorder
+
+    policy = {"wb_bloom": store_cache.WB_BLOOM,
+              "wb_nobloom": store_cache.WB_NOBLOOM,
+              "wt": store_cache.WT}[tag]
+    cache_buckets = 1 << (10 if quick else 16)
+    n_keys = cache_buckets * 8           # cache holds ~half the keyspace
+    width = 1_024 if quick else 4_096
+
+    srv = CachedStore(cache_buckets, val_words=10, policy=policy,
+                      width=width)
+    keys_all = np.arange(1, n_keys + 1, dtype=np.uint64)
+    vals = np.zeros((n_keys, 10), np.uint32)
+    vals[:, 0] = keys_all.astype(np.uint32)
+    vals[:, 1] = STORE_MAGIC
+    srv.populate(keys_all, vals)
+
+    rng = np.random.default_rng(0)
+    wv = np.zeros((width, 10), np.uint32)
+    wv[:, 1] = STORE_MAGIC
+
+    def wave():
+        k = rng.integers(1, int(n_keys * 1.1), width).astype(np.uint64)
+        is_read = rng.random(width) < 0.5
+        ops = np.where(is_read, Op.GET, Op.SET).astype(np.int32)
+        t0 = time.monotonic()
+        srv.serve(ops, k, wv)
+        rec.record(width, width, np.full(width,
+                                         (time.monotonic() - t0) * 1e6))
+
+    rec = Recorder()
+    wave()     # compiles cache_step; queues refills for its misses
+    wave()     # compiles the refill path (pending is non-empty now)
+    rec.reset()
+    srv.stats.__init__()
+    t0 = time.time()
+    while time.time() - t0 < window_s:
+        wave()
+    block = rec.block(time.time() - t0)
+    st = srv.stats
+    block.extra.update(policy=tag, hits=st.hits, misses=st.misses,
+                       bloom_negatives=st.bloom_negatives,
+                       writebacks=st.writebacks,
+                       hit_rate=round(st.hits / max(st.hits + st.misses, 1),
+                                      4))
+    return block.to_dict()
+
 
 def _store_wire_bench(window_s, quick):
     """store served OVER THE WIRE: reference-wire-format UDP datagrams

@@ -81,3 +81,12 @@ def test_wrapped_ring_refuses_recovery():
         recovery.recover_smallbank_dense(
             sd.create(n_acc), np.asarray(db.log.entries)[0],
             np.asarray(db.log.head)[0])
+
+
+def test_geometry_mismatch_refuses_recovery():
+    # log from n_sub=64 against a smaller db0: must raise, not corrupt
+    _, db = _run_tatp(64, w=128, blocks=2)
+    small = td.populate(np.random.default_rng(0), 4, val_words=VW)
+    with pytest.raises(ValueError, match="geometry"):
+        recovery.recover_tatp_dense(small, np.asarray(db.log.entries)[0],
+                                    np.asarray(db.log.head)[0])
