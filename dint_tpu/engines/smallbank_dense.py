@@ -2,39 +2,70 @@
 
 Companion to engines/tatp_dense.py for the SmallBank workload, replacing
 the vmapped sort-based smallbank.step pair the device-fused pipeline pays
-per cohort (engines/smallbank_pipeline.py). Same structural moves:
+per cohort (engines/smallbank_pipeline.py). Structural moves, each forced
+by a measured v5e fact:
 
 * SAVINGS/CHECKING are dense 0..N-1 (smallbank/ebpf/smallbank.h:20-66), so
   both tables live in ONE flat row-id space: row = table*N + account, with
-  row M = 2N as the never-written gather sentinel.
-* The 3 servers' S/X lock tables partition by key%3
-  (smallbank/caladan/client_ebpf_shard.cc:287-289), so their union is one
-  exact pair of arrays: x_held bool [M+1] + s_count i32 [M+1].
+  row M = 2N as the never-written gather sentinel. Balances are a single
+  1-D u32 array — any 2-D [M, k] layout is tiled to 128 words/row by XLA
+  (24 GB at the reference's 24M accounts ([48M, 3, 2] u32 does not even
+  compile on a 16 GB chip — observed), and 1-D scatters/gathers are the
+  fast path anyway.
+
 * Replicas are bit-identical by construction (CommitLog x3 + CommitBck x2 +
-  CommitPrim install everywhere), kept as axis 1 of val/ver and written by
-  one row-major unique scatter; reads gather replica 0.
+  CommitPrim install everywhere, smallbank/caladan/client_ebpf_shard.cc:
+  389-560), so table content is stored once; the replication that matters
+  for recovery stays physical in the log x3 (tables/log.RepLog). The
+  multi-chip path (parallel/sharded.py) places real per-device replicas.
+
+* Locks live in a HASHED slot space like the reference's lock tables
+  (lock arrays indexed by a key hash, with hash-conflation conflicts,
+  smallbank/ebpf/shard_kern.c:26-38) — exact (slot == row) whenever the
+  table fits the slot cap, multiply-shift hashed above that. Because every
+  lock is held for EXACTLY one pipeline step (acquire at wave 1 of step T,
+  release at wave 2 in step T+1), lock state is a step stamp, not a
+  counter: slot held at step T iff its stamp == T-1. Releases are implicit
+  (stamps go stale), which deletes the X-release scatter and the
+  duplicate-index S-count inc/dec scatters (duplicate-index scatters
+  serialize on TPU) from the hot loop entirely.
+
+* Per-row version words exist in the reference to order replicated
+  installs (versioned kvs_set). Under deterministic batch certification
+  the pipeline step index IS that order: log entries carry ver = step, so
+  recovery's max-version-per-row rule works unchanged, and the table
+  needs no version array (2 fewer random ops per step).
 
 No-wait S/X arbitration without a sort (the closed form of processing a
-row's lock requests in lane order, == the reference's per-entry CAS +
+slot's lock requests in lane order, == the reference's per-entry CAS +
 grant/reject counters, smallbank/ebpf/shard_kern.c:96-328):
-  first_x, first_s = per-row scatter-min of lane index over X / S requests
-  x_wins(row)      = first_x < first_s  and row free (no X held, no S held)
+  first_x, first_s = per-slot scatter-min of lane index over X / S requests
+  x_wins(slot)     = first_x < first_s  and slot free last step
   X grant          = x_wins and lane == first_x
-  S grant          = row has no X held and not x_wins
+  S grant          = slot has no X stamp and not x_wins
 (if any S precedes the first X, the X rejects and ALL batch S's share the
-row; if an X is first on a free row it takes it and everything else
-rejects.)
+slot; if an X is first on a free slot it takes it and everything else
+rejects.) The S stamp is written by the first S lane only, so every
+scatter in the step has provably unique indices.
 
 The 2-stage software pipeline fuses, per device step,
   wave 1 of cohort t     (S/X lock + fused balance read + compute),
-                         arbitrated against cohort t-1's STILL-HELD locks
-  wave 2 of cohort t-1   (install + release + log x3), applied after
+                         arbitrated against cohort t-1's STILL-HELD stamps
+  wave 2 of cohort t-1   (install + log x3), applied after
 so locks are held across one step boundary and lock conflicts between
 consecutive cohorts are real concurrency, exactly like the reference's
-overlapping in-flight txns (acquire-before-release is what makes that
-true — a release-first order would hand every acquire an empty lock
-table). Per-txn balance logic is shared with the generic pipeline
+overlapping in-flight txns. The wave-1 balance gather safely precedes
+c1's installs: any row c1 installs was X-stamped by c1, so this cohort's
+acquire on it REJECTed and its pre-install value is never consumed.
+Per-txn balance logic is shared with the generic pipeline
 (smallbank_pipeline.compute_phase).
+
+The magic-word integrity check of the generic engines (STAT_MAGIC_BAD) is
+structurally vacuous here — balances live alone in their array, and the
+magic word would be a never-mutated constant — so it is not stored; the
+window-wide balance-conservation invariant (bench_smallbank) is the
+stronger integrity oracle. The stat slot is kept (always 0) for schema
+compatibility.
 """
 from __future__ import annotations
 
@@ -46,7 +77,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..tables import log as logring
-from . import smallbank
 from .types import Op
 from .smallbank_pipeline import (AMT, L, MAGIC, N_SHARDS, TS_AMT_MAX, VW,     # noqa: F401 (re-exported)
                                  STAT_ATTEMPTED, STAT_COMMITTED, STAT_AB_LOCK,
@@ -58,55 +88,80 @@ I32 = jnp.int32
 U32 = jnp.uint32
 
 BIG = jnp.int32(1 << 30)
+MAX_LOCK_SLOTS = 1 << 25
+
+
+def lock_slots_for(m1: int) -> int:
+    """Lock-table size: exact (>= m1) up to 2^25, hashed above — the
+    reference's lock arrays are likewise a fixed hash space (~1.5x the
+    keyspace, smallbank/ebpf/utils.h:16-17) with hash-conflation rejects.
+    The cap trades conflation aborts against per-access cost on the stamp
+    arrays (measured on v5e at the reference's 48M rows: 2^24 slots ->
+    1.07M txn/s at 11.4% aborts of which ~5% are conflation; 2^26 -> 473k
+    at 6.6% with conflation ~0; 2^25 is the balance point)."""
+    return min(1 << (m1 - 1).bit_length(), MAX_LOCK_SLOTS)
 
 
 @flax.struct.dataclass
 class DenseBank:
     """Both tables + locks + logs in flat dense arrays (row M = 2N is the
     gather sentinel; masked scatters route out of bounds and drop)."""
-    val: jax.Array       # u32 [M+1, 3, VW]  replica-identical values
-    ver: jax.Array       # u32 [M+1, 3]
-    x_held: jax.Array    # bool [M+1]  union of the 3 servers' X-lock maps
-    s_count: jax.Array   # i32 [M+1]   union of the 3 servers' S counts
-    log: logring.LogRing   # stacked [3] leading axis
+    bal: jax.Array       # u32 [M+1]  balances (i32 bits)
+    x_step: jax.Array    # u32 [H]    last step an X grant stamped the slot
+    s_step: jax.Array    # u32 [H]    last step an S grant stamped the slot
+    step: jax.Array      # u32 scalar, monotonic (starts at 2: stamp 0 is
+                         #   "never held", so step-1 must never be 0)
+    log: logring.RepLog  # 3 replica entries packed per slot (log x3)
 
     @property
     def n_accounts(self):
-        return self.x_held.shape[0] // 2
+        return self.bal.shape[0] // 2
+
+    @property
+    def lock_slots(self):
+        return self.x_step.shape[0]
 
 
 def create(n_accounts: int, init_balance: int = 1000, log_lanes: int = 16,
-           log_capacity: int = 1 << 20) -> DenseBank:
+           log_capacity: int = 1 << 16) -> DenseBank:
     """Populated on device (reference: smallbank/ebpf/shard_user.c:74-77);
-    every account starts at init_balance with the magic word set."""
+    every account starts at init_balance."""
     m1 = 2 * n_accounts + 1
-    val = jnp.zeros((m1, N_SHARDS, VW), U32)
-    val = val.at[:-1, :, 0].set(U32(init_balance))
-    val = val.at[:-1, :, 1].set(U32(MAGIC))
-    ver = jnp.ones((m1, N_SHARDS), U32).at[-1].set(0)
-    one_log = logring.create(log_lanes, log_capacity, VW)
+    h = lock_slots_for(m1)
+    bal = jnp.full((m1,), np.uint32(init_balance), U32).at[-1].set(0)
     return DenseBank(
-        val=val, ver=ver,
-        x_held=jnp.zeros((m1,), bool),
-        s_count=jnp.zeros((m1,), I32),
-        log=jax.tree.map(lambda x: jnp.stack([x] * N_SHARDS), one_log),
+        bal=bal,
+        x_step=jnp.zeros((h,), U32),
+        s_step=jnp.zeros((h,), U32),
+        step=jnp.asarray(2, U32),
+        log=logring.create_rep(log_lanes, log_capacity, VW,
+                               replicas=N_SHARDS),
     )
 
 
+def _slot_of(rows, m1: int, h: int):
+    """Row -> lock slot: identity when exact, multiply-shift hash when the
+    keyspace exceeds the lock table (the reference's fasthash-indexed lock
+    arrays conflate keys the same way)."""
+    if h >= m1:
+        return rows
+    shift = 32 - int(np.log2(h))
+    return ((rows.astype(U32) * U32(0x9E3779B1)) >> U32(shift)).astype(I32)
+
+
 def total_balance(db: DenseBank, replica: int = 0):
-    """Device-side balance sum over one replica (mod 2^32, i32 accumulate —
-    conservation compares deltas under the same wraparound)."""
-    return db.val[:-1, replica, 0].astype(I32).sum(dtype=I32)
+    """Device-side balance sum (mod 2^32, i32 accumulate — conservation
+    compares deltas under the same wraparound). `replica` kept for
+    signature compatibility: table content is stored once."""
+    return db.bal[:-1].astype(I32).sum(dtype=I32)
 
 
 @flax.struct.dataclass
 class BankCtx:
-    """A cohort between lock+compute (wave 1) and install+release (wave 2).
-    Stats are emitted when the writes land. Bootstrap cohorts have
-    attempted == 0 and all-False masks."""
+    """A cohort between lock+compute (wave 1) and install (wave 2); lock
+    release is implicit (stamps expire). Stats are emitted when the writes
+    land. Bootstrap cohorts have attempted == 0 and all-False masks."""
     rows: jax.Array      # i32 [w, L] flat row ids (sentinel if inactive)
-    granted: jax.Array   # bool [w, L]
-    is_x: jax.Array      # bool [w, L] granted lock is exclusive
     do_write: jax.Array  # bool [w, L]
     nw: jax.Array        # i32 [w, L] new balances
     tbl: jax.Array       # i32 [w, L] (for the log)
@@ -115,7 +170,7 @@ class BankCtx:
     committed: jax.Array   # i32 scalar
     ab_lock: jax.Array     # i32 scalar
     ab_logic: jax.Array    # i32 scalar
-    magic_bad: jax.Array   # i32 scalar
+    magic_bad: jax.Array   # i32 scalar (structurally 0, kept for schema)
     bal_delta: jax.Array   # i32 scalar
 
 
@@ -124,8 +179,7 @@ def empty_ctx(w: int) -> BankCtx:
         return jnp.asarray(np.zeros(shape, dt))
 
     return BankCtx(
-        rows=z((w, L), np.int32), granted=z((w, L), bool),
-        is_x=z((w, L), bool), do_write=z((w, L), bool),
+        rows=z((w, L), np.int32), do_write=z((w, L), bool),
         nw=z((w, L), np.int32), tbl=z((w, L), np.int32),
         acc=z((w, L), np.int32),
         attempted=z((), np.int32), committed=z((), np.int32),
@@ -141,17 +195,13 @@ def _stats_of(c: BankCtx):
 def pipe_step(db: DenseBank, c1: BankCtx, key, *, w: int, n_accounts: int,
               gen_new: bool = True, hot_frac=None, hot_prob=None, mix=None):
     """One fused device step: wave 1 of a NEW cohort acquires against c1's
-    STILL-HELD locks, then wave 2 installs c1's writes and releases them.
-    Acquire-before-release is what makes cross-cohort lock conflicts real:
-    cohort t's locks are visible to cohort t+1's no-wait acquires, exactly
-    like the reference's overlapping in-flight txns. The order is safe for
-    the fused reads too — any row c1 is about to install was X-held by c1,
-    so the new cohort's acquire on it REJECTed and its (pre-install) value
-    is never consumed; S-held rows are unmodified by definition.
+    STILL-HELD stamps (stamp == step-1), then wave 2 installs c1's writes.
     Returns (db', new_ctx, stats-of-c1)."""
     m1 = 2 * n_accounts + 1
     sent = m1 - 1
     oob = m1
+    h = db.lock_slots
+    t = db.step
     kgen, kamt = jax.random.split(key)
 
     # ---- wave 1: new cohort lock + fused read + compute -------------------
@@ -174,34 +224,37 @@ def pipe_step(db: DenseBank, c1: BankCtx, key, *, w: int, n_accounts: int,
     active = l_op != 0
     rows = jnp.where(active, l_tb * n_accounts + l_ac, sent)  # [w, L]
     flat_rows = rows.reshape(-1)
+    slot = _slot_of(flat_rows, m1, h)                         # [wL]
     is_x_lane = (l_op == Op.ACQ_X_READ).reshape(-1)
     is_s_lane = (l_op == Op.ACQ_S_READ).reshape(-1)
     lane = jnp.arange(w * L, dtype=I32)
 
-    first_x = jnp.full((m1,), BIG, I32).at[
-        jnp.where(is_x_lane, flat_rows, oob)].min(lane, mode="drop")
-    first_s = jnp.full((m1,), BIG, I32).at[
-        jnp.where(is_s_lane, flat_rows, oob)].min(lane, mode="drop")
-    # arbitrate against c1's STILL-HELD locks (released below, after)
-    row_free = ~db.x_held & (db.s_count == 0)
-    x_wins = (first_x < first_s) & row_free
-    grant_x = is_x_lane & x_wins[flat_rows] & (first_x[flat_rows] == lane)
-    grant_s = is_s_lane & ~db.x_held[flat_rows] & ~x_wins[flat_rows]
-    x_held = db.x_held.at[jnp.where(grant_x, flat_rows, oob)].set(
-        True, mode="drop", unique_indices=True)
-    s_count = db.s_count.at[jnp.where(grant_s, flat_rows, oob)].add(
-        1, mode="drop")
+    first_x = jnp.full((h,), BIG, I32).at[
+        jnp.where(is_x_lane, slot, h)].min(lane, mode="drop")
+    first_s = jnp.full((h,), BIG, I32).at[
+        jnp.where(is_s_lane, slot, h)].min(lane, mode="drop")
+    # held = stamped by the previous step's cohort (released implicitly
+    # one step later; acquire-before-release semantics preserved)
+    held_x = db.x_step[slot] == t - 1
+    held_s = db.s_step[slot] == t - 1
+    slot_free = ~held_x & ~held_s
+    x_wins = (first_x[slot] < first_s[slot]) & slot_free
+    grant_x = is_x_lane & x_wins & (first_x[slot] == lane)
+    grant_s = is_s_lane & ~held_x & ~x_wins
+    x_step = db.x_step.at[jnp.where(grant_x, slot, h)].set(
+        t, mode="drop", unique_indices=True)
+    # one writer per slot: the first S lane stamps for all sharers
+    s_step = db.s_step.at[
+        jnp.where(grant_s & (first_s[slot] == lane), slot, h)].set(
+        t, mode="drop", unique_indices=True)
 
     granted = (grant_x | grant_s).reshape(w, L)
     lock_rejected = (active & ~granted).any(axis=1)
     alive = ~lock_rejected & (l_op[:, 0] != 0)
 
-    # fused reads from the pre-install tables: rows c1 will install below
-    # were X-held by c1, so this cohort never granted (or reads) them
-    gbal = db.val[flat_rows, 0, 0].astype(I32)
-    gmagic = db.val[flat_rows, 0, 1]
-    magic_bad = jnp.sum((grant_x | grant_s) & (gmagic != MAGIC), dtype=I32)
-    bal = jnp.where(granted, gbal.reshape(w, L), 0)
+    # fused reads from the pre-install table: rows c1 installs below were
+    # X-stamped by c1, so this cohort never granted (or consumed) them
+    bal = jnp.where(granted, db.bal[flat_rows].astype(I32).reshape(w, L), 0)
 
     nw, do, logic_abort, commit, committed = compute_phase(
         ttype, bal, alive, ts_amt)
@@ -209,50 +262,34 @@ def pipe_step(db: DenseBank, c1: BankCtx, key, *, w: int, n_accounts: int,
     bal_delta = jnp.sum(jnp.where(do_write, nw - bal, 0), dtype=I32)
 
     new_ctx = BankCtx(
-        rows=rows, granted=granted, is_x=is_x_lane.reshape(w, L),
-        do_write=do_write, nw=nw, tbl=l_tb, acc=l_ac,
+        rows=rows, do_write=do_write, nw=nw, tbl=l_tb, acc=l_ac,
         attempted=jnp.asarray(w if gen_new else 0, I32),
         committed=committed.sum(dtype=I32),
         ab_lock=(lock_rejected & (l_op[:, 0] != 0)).sum(dtype=I32),
         ab_logic=logic_abort.sum(dtype=I32),
-        magic_bad=magic_bad,
+        magic_bad=jnp.asarray(0, I32),
         bal_delta=bal_delta)
 
-    # ---- wave 2 of c1: install + release + log x3 -------------------------
+    # ---- wave 2 of c1: install + log x3 (locks expire by stamp) -----------
     dwf = c1.do_write.reshape(-1)
     wrows = jnp.where(dwf, c1.rows.reshape(-1), oob)       # [wL]
     newbal = c1.nw.reshape(-1)
+    bal_new = db.bal.at[wrows].set(newbal.astype(U32), mode="drop",
+                                   unique_indices=True)
+
     newval = jnp.zeros((wrows.shape[0], VW), U32)
     newval = newval.at[:, 0].set(newbal.astype(U32))
     newval = newval.at[:, 1].set(jnp.where(dwf, U32(MAGIC), U32(0)))
-    newver = db.ver[jnp.clip(wrows, 0, sent), 0] + 1
-
-    def rep(x):
-        return jnp.broadcast_to(x[:, None], x.shape[:1] + (N_SHARDS,)
-                                + x.shape[1:])
-
-    val = db.val.at[wrows].set(rep(newval), mode="drop", unique_indices=True)
-    ver = db.ver.at[wrows].set(rep(newver), mode="drop", unique_indices=True)
-
-    # release c1's locks AFTER the new cohort's acquires saw them; X rows
-    # granted this step are disjoint from c1's (they were held), S counts
-    # compose by +/-
-    relx = (c1.granted & c1.is_x).reshape(-1)
-    rels = (c1.granted & ~c1.is_x).reshape(-1)
-    x_held = x_held.at[jnp.where(relx, c1.rows.reshape(-1), oob)].set(
-        False, mode="drop", unique_indices=True)
-    s_count = s_count.at[jnp.where(rels, c1.rows.reshape(-1), oob)].add(
-        -1, mode="drop")
-
     zero = jnp.zeros_like(newbal, U32)
-    logs = jax.vmap(
-        lambda ring: logring.append(ring, dwf, c1.tbl.reshape(-1),
-                                    jnp.zeros_like(newbal), zero,
-                                    c1.acc.reshape(-1).astype(U32),
-                                    newver, newval)[0])(db.log)
+    # log ver = step index: monotonic per row (one X-writer per row per
+    # step), which is all recovery's max-ver-per-row rule needs
+    stepv = jnp.broadcast_to(t, newbal.shape)
+    logs = logring.append_rep(db.log, dwf, c1.tbl.reshape(-1),
+                              jnp.zeros_like(newbal), zero,
+                              c1.acc.reshape(-1).astype(U32), stepv, newval)
 
-    db = db.replace(val=val, ver=ver, x_held=x_held, s_count=s_count,
-                    log=logs)
+    db = db.replace(bal=bal_new, x_step=x_step, s_step=s_step,
+                    step=t + 1, log=logs)
     return db, new_ctx, _stats_of(c1)
 
 

@@ -21,31 +21,50 @@ structural facts the reference cannot:
 2. **The 3 servers' lock tables partition by key.** Locks for key k are
    only ever taken at server k%3 (tatp/caladan/client_ebpf_shard.cc:
    636-641), so the union of the 3 per-server lock arrays is one exact
-   per-row bool array — no routing, no hash conflation (exact locks also
+   per-row lock bit — no routing, no hash conflation (exact locks also
    remove the reference's false REJECT_LOCK on hash collisions, the
    ablation its lock_kern.c instrumentation exists to measure).
 
 3. **Replicas are bit-identical by construction.** Every certified write
    applies at primary + both backups (client_ebpf_shard.cc:779-900), so
-   val/ver/exists carry a leading [3] replica axis written with one
-   broadcast scatter; reads gather from replica 0 == the owner's copy.
-   The replica axis is the unit that shards across chips in the
-   multi-chip mesh (parallel/sharded.py).
+   the single-chip engine stores table content ONCE and keeps the
+   replication physical where it matters for recovery: the log x3
+   (tables/log.RepLog packs 3 replica entries per slot). The multi-chip
+   path (parallel/sharded.py) places real per-device replicas; a
+   single-chip emulation holding 3 bit-identical copies in one HBM adds
+   no fidelity — it only triples memory (measured: XLA tiles [N, 3, VW]
+   u32 to 2 KB/row, 4.5 GB for the bench's 2.2M rows).
+
+Per-row metadata packs into ONE u32 word (`meta`):
+
+    bits [31:2] = ver   (monotonic: commit/insert/delete all bump it, so
+                         OCC validate is an equality compare with no
+                         delete/reinsert ABA window)
+    bit  1      = exists
+    bit  0      = locked (the union of the 3 servers' lock tables)
+
+``meta >> 1`` (ver:exists, lock bit dropped) is the value OCC validation
+compares — reads do not observe locks, exactly the reference's verify
+stage (client_ebpf_shard.cc:765-768). One gather serves wave-1 read +
+lock + existence + version; one scatter per step installs commits AND
+releases locks (an install writes ``(ver+1)<<2 | exists<<1 | 0``; an
+abort-release rewrites the wave-1 value with bit0 clear, reconstructed
+from the carried version — the row was X-held in between, so no re-read
+is needed).
 
 Conflict resolution per fused step (replacing ops/segments.sort_batch):
   * commits: X-certified one-writer-per-row -> direct scatter.
-  * lock acquires: first-lane-wins via scatter-min of lane index into a
-    per-row winner scratch, then a gather-back compare — the batched
-    equivalent of the reference's CAS loop (shard_kern.c:251-297).
+  * lock acquires: first-slot-wins via scatter-min of write-slot index
+    into a per-row winner scratch, then a gather-back compare — the
+    batched equivalent of the reference's CAS loop (shard_kern.c:251-297).
+    Arbitration runs in [w, 2] write-slot space (2 lock slots per txn),
+    measured 2x cheaper than arbitrating all [w, K] lanes.
   * reads/validates: pure gathers.
-Versions are monotonic: commit/insert/delete all bump ver, so OCC validate
-is a single u32 compare with no delete/reinsert ABA window.
 
-Scatter discipline (TPU): every table scatter is row-major on axis 0 with
-``unique_indices=True`` and masked lanes routed OUT OF BOUNDS under
-``mode="drop"`` — duplicate-index scatters serialize on TPU (measured
-89 ms for one [2w]-row update into [3, N, VW] on axis 1 vs row-major
-unique scatters), and uniqueness is guaranteed by certification (one
+Scatter discipline (TPU, all measured on v5e): every scatter is 1-D or
+row-major on axis 0 with ``unique_indices=True`` and masked lanes routed
+OUT OF BOUNDS under ``mode="drop"`` — duplicate-index and multi-dim-index
+scatters serialize, and uniqueness is guaranteed by certification (one
 X-lock holder per row). Row N is a never-written sentinel that NOP lanes
 gather from; OOB gather indices clip onto it.
 
@@ -54,10 +73,10 @@ commit of t-2 fused into ONE device program) is inherited from
 engines/tatp_pipeline.py, which remains the semantics reference; its
 gen_cohort (txn mix, NURand, lane layout) is reused verbatim.
 
-Memory: ~22*(n_sub+1) rows; val replicas dominate at 3*N*VW u32. At the
-bench's n_sub=1e5 that's ~260 MB — single-chip HBM. Reference scale
-(n_sub=7e6) needs the multi-chip shard path, as it does for the reference
-(3 servers).
+Memory: ~22*(n_sub+1) rows; val dominates at N*VW u32 (tiled to 128
+words/row). At the bench's n_sub=1e5 that's ~1.1 GB + a 0.5 GB log —
+single-chip HBM. Reference scale (n_sub=7e6) needs the multi-chip shard
+path, as it does for the reference (3 servers).
 """
 from __future__ import annotations
 
@@ -68,7 +87,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..clients import workloads as wl
 from ..tables import log as logring
 from . import tatp
 from .types import Op, Reply
@@ -95,29 +113,37 @@ def n_rows(n_sub: int) -> int:
 @flax.struct.dataclass
 class DenseDB:
     """All 5 TATP tables + locks + logs in flat dense arrays (row N is the
-    sentinel every NOP/padded lane gathers from; it is never written).
-    Replicas are the SECOND axis so table scatters are row-major."""
-    val: jax.Array      # u32 [N+1, 3, VW]   replica-identical values
-    ver: jax.Array      # u32 [N+1, 3]       monotonic (bumped by every write)
-    exists: jax.Array   # bool [N+1, 3]
-    locked: jax.Array   # bool [N+1]         union of the 3 servers' lock maps
-    log: logring.LogRing   # stacked [3] leading axis (log x3 replication)
+    sentinel every NOP/padded lane gathers from; it is never written)."""
+    val: jax.Array      # u32 [N+1, VW]  word0 payload, word1 magic
+    meta: jax.Array     # u32 [N+1]      ver<<2 | exists<<1 | locked
+    log: logring.RepLog   # 3 replica entries packed per slot (log x3)
 
     @property
     def n_sub(self):
-        return self.locked.shape[0] // 22 - 1
+        return self.meta.shape[0] // 22 - 1
+
+    # convenience views (tests / recovery / oracles — not the hot path)
+    @property
+    def ver(self):
+        return self.meta >> 2
+
+    @property
+    def exists(self):
+        return (self.meta & 2) != 0
+
+    @property
+    def locked(self):
+        return (self.meta & 1) != 0
 
 
 def create(n_sub: int, val_words: int = 10, log_lanes: int = 16,
-           log_capacity: int = 1 << 20) -> DenseDB:
+           log_capacity: int = 1 << 16) -> DenseDB:
     n1 = n_rows(n_sub) + 1
-    one_log = logring.create(log_lanes, log_capacity, val_words)
     return DenseDB(
-        val=jnp.zeros((n1, N_SHARDS, val_words), U32),
-        ver=jnp.zeros((n1, N_SHARDS), U32),
-        exists=jnp.zeros((n1, N_SHARDS), bool),
-        locked=jnp.zeros((n1,), bool),
-        log=jax.tree.map(lambda x: jnp.stack([x] * N_SHARDS), one_log),
+        val=jnp.zeros((n1, val_words), U32),
+        meta=jnp.zeros((n1,), U32),
+        log=logring.create_rep(log_lanes, log_capacity, val_words,
+                               replicas=N_SHARDS),
     )
 
 
@@ -134,14 +160,12 @@ def populate(rng: np.random.Generator, n_sub: int, val_words: int = 10,
     base = _bases(p1)
 
     val = np.zeros((n1, val_words), np.uint32)
-    ver = np.zeros(n1, np.uint32)
-    exists = np.zeros(n1, bool)
+    meta = np.zeros(n1, np.uint32)
 
     def put(rows, payload):
         val[rows, 0] = payload.astype(np.uint32)
         val[rows, 1] = MAGIC
-        ver[rows] = 1
-        exists[rows] = True
+        meta[rows] = (1 << 2) | (1 << 1)      # ver 1, exists, unlocked
 
     s_ids = np.arange(1, p1)
     put(base[tatp.SUBSCRIBER] + s_ids, s_ids)
@@ -165,11 +189,7 @@ def populate(rng: np.random.Generator, n_sub: int, val_words: int = 10,
     cf_keys = np.unique(np.concatenate(cf_keys)).astype(np.int64)
     put(base[tatp.CALL_FORWARDING] + cf_keys, cf_keys)
 
-    return db.replace(
-        val=jnp.asarray(np.repeat(val[:, None], N_SHARDS, axis=1)),
-        ver=jnp.asarray(np.repeat(ver[:, None], N_SHARDS, axis=1)),
-        exists=jnp.asarray(np.repeat(exists[:, None], N_SHARDS, axis=1)),
-    )
+    return db.replace(val=jnp.asarray(val), meta=jnp.asarray(meta))
 
 
 # ---------------------------------------------------------------- pipeline
@@ -178,15 +198,16 @@ def populate(rng: np.random.Generator, n_sub: int, val_words: int = 10,
 @flax.struct.dataclass
 class DenseCtx:
     """An in-flight cohort between pipeline stages (cf. tatp_pipeline.PipeCtx
-    — row ids are precomputed once at wave 1). Bootstrap cohorts have
-    attempted == 0 and all-False masks."""
+    — row ids and versions are captured once at wave 1). Bootstrap cohorts
+    have attempted == 0 and all-False masks."""
     rows: jax.Array       # i32 [w, K] flat row ids (sentinel for NOP lanes)
     is_read: jax.Array    # bool [w, K] OCC_READ lanes
-    rver1: jax.Array      # u32 [w, K] raw row versions at wave 1
+    vv1: jax.Array        # u32 [w, K] meta>>1 (ver:exists) at wave 1
     alive: jax.Array      # bool [w]
     ro_commit: jax.Array  # bool [w]
     granted: jax.Array    # bool [w, 2]
     ws_rows: jax.Array    # i32 [w, 2] write-slot row ids (sentinel if inactive)
+    ws_vv: jax.Array      # u32 [w, 2] write-slot ver:exists at wave 1
     ws_tbl: jax.Array     # i32 [w, 2]
     ws_key: jax.Array     # i32 [w, 2] (logged key)
     ws_kind: jax.Array    # i32 [w, 2] 0 commit / 1 insert / 2 delete
@@ -204,9 +225,10 @@ def empty_ctx(w: int) -> DenseCtx:
 
     return DenseCtx(
         rows=z((w, K), np.int32), is_read=z((w, K), bool),
-        rver1=z((w, K), np.uint32), alive=z((w,), bool),
+        vv1=z((w, K), np.uint32), alive=z((w,), bool),
         ro_commit=z((w,), bool), granted=z((w, 2), bool),
-        ws_rows=z((w, 2), np.int32), ws_tbl=z((w, 2), np.int32),
+        ws_rows=z((w, 2), np.int32), ws_vv=z((w, 2), np.uint32),
+        ws_tbl=z((w, 2), np.int32),
         ws_key=z((w, 2), np.int32), ws_kind=z((w, 2), np.int32),
         ws_active=z((w, 2), bool),
         attempted=z((), np.int32), ab_lock=z((), np.int32),
@@ -236,10 +258,27 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
     kg, kv3 = jax.random.split(key)
 
     # ---- wave 3 of c2: install + unlock + log -----------------------------
+    # one meta scatter covers every granted slot: installs write the bumped
+    # version with the lock bit clear (COMMIT/INSERT/DELETE_PRIM release the
+    # row lock, shard_kern.c:338-476); aborted-but-granted slots rewrite
+    # their wave-1 value with bit0 clear (the row was X-held since wave 1,
+    # so ws_vv is still current — no re-read). Uniqueness: one X-holder per
+    # row, and a txn's two slots target different tables.
     do_write = c2.ws_active & c2.alive[:, None]                 # [w, 2]
     wmask = do_write.reshape(-1)
-    wrows = jnp.where(wmask, c2.ws_rows.reshape(-1), oob)       # [2w]
+    release = c2.granted.reshape(-1) & ~wmask
+    touch = wmask | release
+    trows = jnp.where(touch, c2.ws_rows.reshape(-1), oob)       # [2w]
     wkind = c2.ws_kind.reshape(-1)
+    newex = (wkind != 2) & wmask
+    vv = c2.ws_vv.reshape(-1)
+    meta_new = jnp.where(
+        wmask, (((vv >> 1) + 1) << 2) | (newex.astype(U32) << 1),
+        vv << 1)
+    meta = db.meta.at[trows].set(meta_new, mode="drop",
+                                 unique_indices=True)
+
+    wrows = jnp.where(wmask, c2.ws_rows.reshape(-1), oob)
     payload = jax.random.randint(kv3, (w, 2), 0, 1 << 16, dtype=I32)
     newval = jnp.zeros((w, 2, val_words), U32)
     newval = newval.at[:, :, 0].set(payload.astype(U32))
@@ -247,44 +286,19 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
         jnp.where(do_write & (c2.ws_kind != 2), U32(MAGIC), U32(0)))
     newval = newval.reshape(-1, val_words)
     newval = jnp.where((wkind == 2)[:, None], U32(0), newval)   # delete zeroes
+    val = db.val.at[wrows].set(newval, mode="drop", unique_indices=True)
 
-    oldver = db.ver[jnp.clip(wrows, 0, sent), 0]
-    newver = oldver + 1                     # monotonic: no delete/insert ABA
-    newex = wkind != 2
-
-    # one row-major scatter per array installs at primary + both backups
-    # (log x3 + bck x2 + prim install, client_ebpf_shard.cc:779-900);
-    # X-certification guarantees row uniqueness among unmasked lanes
-    def rep(x):
-        return jnp.broadcast_to(x[:, None], x.shape[:1] + (N_SHARDS,)
-                                + x.shape[1:])
-
-    val = db.val.at[wrows].set(rep(newval), mode="drop",
-                               unique_indices=True)
-    ver = db.ver.at[wrows].set(rep(newver), mode="drop",
-                               unique_indices=True)
-    exists = db.exists.at[wrows].set(rep(newex), mode="drop",
-                                     unique_indices=True)
-
-    # every granted lock releases here: COMMIT/INSERT/DELETE_PRIM for alive
-    # txns, ABORT for dead ones (client_ebpf_shard.cc:681-703)
-    unlock_rows = jnp.where(c2.granted.reshape(-1),
-                            c2.ws_rows.reshape(-1), oob)
-    locked = db.locked.at[unlock_rows].set(False, mode="drop",
-                                           unique_indices=True)
-
+    newver = (vv >> 1) + 1
     flags_del = (wkind == 2).astype(I32)
     log_tbl = c2.ws_tbl.reshape(-1)
     log_key = c2.ws_key.reshape(-1).astype(U32)
     zero_hi = jnp.zeros_like(log_key)
-    logs = jax.vmap(
-        lambda ring: logring.append(ring, do_write.reshape(-1), log_tbl,
-                                    flags_del, zero_hi, log_key, newver,
-                                    newval)[0])(db.log)
+    logs = logring.append_rep(db.log, wmask, log_tbl, flags_del, zero_hi,
+                              log_key, newver, newval)
 
     # ---- wave 2 of c1: validate read-set version compare ------------------
-    vver = ver[c1.rows, 0]                                      # [w, K]
-    bad = c1.is_read & (vver != c1.rver1)
+    vvB = meta[c1.rows] >> 1                                    # [w, K]
+    bad = c1.is_read & (vvB != c1.vv1)
     changed = bad.any(axis=1)
     c1 = c1.replace(alive=c1.alive & ~changed,
                     ab_validate=(c1.alive & changed).sum(dtype=I32))
@@ -307,42 +321,47 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
     used = ops != Op.NOP
     rows = jnp.where(used, base[tbl] + kk, sent)                # [w, K]
     is_read = ops == Op.OCC_READ
-    is_lock = ops == Op.OCC_LOCK
 
-    rver1 = ver[rows, 0]
-    rex = exists[rows, 0]
-    rmagic = val[rows, 0, 1]
+    rmeta = meta[rows]                                          # [w, K]
+    vv1 = rmeta >> 1
+    rex = (rmeta & 2) != 0
+    rmagic = val[rows, 1]
     magic_bad = jnp.sum(is_read & rex & (rmagic != MAGIC), dtype=I32)
 
-    # lock arbitration: first lane wins per row (batched CAS,
-    # tatp/ebpf/shard_kern.c:251-297); losers and held rows REJECT
-    flat_rows = rows.reshape(-1)
-    flat_lock = is_lock.reshape(-1)
-    lane_idx = jnp.arange(w * K, dtype=I32)
-    arb_rows = jnp.where(flat_lock, flat_rows, oob)
-    winner = jnp.full((n1,), BIG, I32).at[arb_rows].min(lane_idx,
-                                                        mode="drop")
-    grant_flat = flat_lock & ~locked[flat_rows] & (winner[flat_rows] == lane_idx)
-    locked = locked.at[jnp.where(grant_flat, flat_rows, oob)].set(
-        True, mode="drop", unique_indices=True)
-    grant = grant_flat.reshape(w, K)
+    # lock arbitration in [w, 2] write-slot space: first slot wins per row
+    # (batched CAS, tatp/ebpf/shard_kern.c:251-297); losers and held rows
+    # REJECT. ws_lane points at this txn's lock lanes, so lock state comes
+    # from the wave-1 gather — no extra fetch.
+    ws_rows = jnp.where(ws_active, base[ws_tbl] + ws_key, sent)  # [w, 2]
+    ws_meta = jnp.take_along_axis(rmeta, ws_lane, axis=1)
+    ws_vv = jnp.take_along_axis(vv1, ws_lane, axis=1)
+    held = (ws_meta & 1) != 0
+    flat_ws = ws_rows.reshape(-1)
+    slot_idx = jnp.arange(2 * w, dtype=I32)
+    arb_rows = jnp.where(ws_active.reshape(-1), flat_ws, oob)
+    winner = jnp.full((n1,), BIG, I32).at[arb_rows].min(slot_idx,
+                                                       mode="drop")
+    grant = (ws_active.reshape(-1) & ~held.reshape(-1)
+             & (winner[flat_ws] == slot_idx)).reshape(w, 2)
+    meta = meta.at[jnp.where(grant.reshape(-1), flat_ws, oob)].set(
+        (ws_vv.reshape(-1) << 1) | 1, mode="drop", unique_indices=True)
 
-    # reply types [w, K]: VAL/NOT_EXIST for reads, GRANT/REJECT for locks
-    rt = jnp.where(is_read, jnp.where(rex, Reply.VAL, Reply.NOT_EXIST),
-                   jnp.where(is_lock,
-                             jnp.where(grant, Reply.GRANT, Reply.REJECT),
-                             Reply.NONE))
+    # reply types: reads from the gather; write-slot GRANT/REJECT direct
+    rt = jnp.where(is_read & used,
+                   jnp.where(rex, Reply.VAL, Reply.NOT_EXIST), Reply.NONE)
+    ws_rt = jnp.where(grant, Reply.GRANT,
+                      jnp.where(ws_active, Reply.REJECT, Reply.NONE))
 
     # ---- wave-1 outcome: shared per-txn-type rules ------------------------
     is_ro, rw, granted, lock_rejected, missing = classify_wave1(
-        ttype, rt, ops, ws_active, ws_lane)
+        ttype, rt, ops, ws_active, ws_lane, ws_rt=ws_rt)
 
-    ws_rows = jnp.where(ws_active, base[ws_tbl] + ws_key, sent)
     new_ctx = DenseCtx(
-        rows=rows, is_read=is_read & used, rver1=rver1,
+        rows=rows, is_read=is_read & used, vv1=vv1,
         alive=rw & ~lock_rejected & ~missing,
         ro_commit=is_ro & ~missing, granted=granted,
-        ws_rows=ws_rows, ws_tbl=ws_tbl, ws_key=ws_key, ws_kind=ws_kind,
+        ws_rows=ws_rows, ws_vv=ws_vv,
+        ws_tbl=ws_tbl, ws_key=ws_key, ws_kind=ws_kind,
         ws_active=ws_active,
         attempted=jnp.asarray(w if gen_new else 0, I32),
         ab_lock=(rw & lock_rejected).sum(dtype=I32),
@@ -351,7 +370,7 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
         ab_validate=jnp.asarray(0, I32),
         magic_bad=magic_bad)
 
-    db = db.replace(val=val, ver=ver, exists=exists, locked=locked, log=logs)
+    db = db.replace(val=val, meta=meta, log=logs)
     return db, new_ctx, c1, _stats_of(c2)
 
 

@@ -359,7 +359,7 @@ def empty_ctx(w: int) -> PipeCtx:
         magic_bad=z((), np.int32))
 
 
-def classify_wave1(ttype, rt, ops, ws_active, ws_lane):
+def classify_wave1(ttype, rt, ops, ws_active, ws_lane, ws_rt=None):
     """Per-txn-type wave-1 outcome rules, shared by every TATP engine.
 
     Given reply types rt [w, K] (VAL/NOT_EXIST for reads, GRANT/REJECT for
@@ -367,13 +367,18 @@ def classify_wave1(ttype, rt, ops, ws_active, ws_lane):
     (read-only commit on success, REJECT -> lock abort, required-row
     absence / insert-exists -> missing abort; client_ebpf_shard.cc:608-703).
     Returns (is_ro, rw, granted [w,2], lock_rejected, missing), all masked
-    to lanes that exist (ops[:,0] != NOP for bootstrap/drain cohorts)."""
+    to lanes that exist (ops[:,0] != NOP for bootstrap/drain cohorts).
+
+    ``ws_rt`` [w, 2]: write-slot reply types, for engines that arbitrate
+    locks in write-slot space and never materialize lock replies in rt
+    (engines/tatp_dense.py); defaults to gathering rt at ws_lane."""
     t = ttype
     is_ro = ((t == wl.TATP_GET_SUBSCRIBER) | (t == wl.TATP_GET_ACCESS)
              | (t == wl.TATP_GET_NEW_DEST)) & (ops[:, 0] != Op.NOP)
     rw = (ops[:, 0] != Op.NOP) & ~is_ro
 
-    ws_rt = jnp.take_along_axis(rt, ws_lane, axis=1)
+    if ws_rt is None:
+        ws_rt = jnp.take_along_axis(rt, ws_lane, axis=1)
     granted = ws_active & (ws_rt == Reply.GRANT)
     rejected = (ws_rt == Reply.REJECT) | (ws_rt == Reply.REJECT_SAME_KEY)
     lock_rejected = (ws_active & rejected).any(axis=1)

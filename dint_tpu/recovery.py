@@ -79,20 +79,24 @@ def recover_tatp_dense(db0, log_entries, log_heads):
     urows, idx = latest_per_row(rows, vers)
 
     val = np.array(db0.val)
-    ver = np.array(db0.ver)
-    exists = np.array(db0.exists)
-    vw = val.shape[2]
-    val[urows] = vals[idx][:, None, :vw]
-    ver[urows] = vers[idx][:, None]
-    exists[urows] = ~is_del[idx][:, None]
-    return db0.replace(val=jnp.asarray(val), ver=jnp.asarray(ver),
-                       exists=jnp.asarray(exists),
-                       locked=jnp.zeros_like(db0.locked))
+    meta = np.array(db0.meta)
+    vw = val.shape[1]
+    val[urows] = vals[idx][:, :vw]
+    # rebuilt meta: logged version + liveness; lock bits are volatile (a
+    # recovering replica restarts with a free lock table, like the
+    # reference's fresh server)
+    meta = meta & ~np.uint32(1)
+    meta[urows] = ((vers[idx].astype(np.uint32) << 2)
+                   | ((~is_del[idx]).astype(np.uint32) << 1))
+    return db0.replace(val=jnp.asarray(val), meta=jnp.asarray(meta))
 
 
 def recover_smallbank_dense(db0, log_entries, log_heads):
     """Same for smallbank_dense.DenseBank (no deletes in SmallBank);
-    db0 fixes the table geometry."""
+    db0 fixes the table geometry. Log `ver` is the pipeline step index
+    (monotonic per row: one X-writer per row per step), so the
+    max-ver-per-row rule applies unchanged; the recovered engine resumes
+    past the last logged step with fresh (expired) lock stamps."""
     import jax.numpy as jnp
 
     n_accounts = int(db0.n_accounts)
@@ -105,11 +109,10 @@ def recover_smallbank_dense(db0, log_entries, log_heads):
     rows = table * n_accounts + key_lo.astype(np.int64)
 
     urows, idx = latest_per_row(rows, vers)
-    val = np.array(db0.val)
-    ver = np.array(db0.ver)
-    vw = val.shape[2]
-    val[urows] = vals[idx][:, None, :vw]
-    ver[urows] = vers[idx][:, None]
-    return db0.replace(val=jnp.asarray(val), ver=jnp.asarray(ver),
-                       x_held=jnp.zeros_like(db0.x_held),
-                       s_count=jnp.zeros_like(db0.s_count))
+    bal = np.array(db0.bal)
+    bal[urows] = vals[idx][:, 0]
+    next_step = max(int(vers.max(initial=1)) + 2, 2)
+    return db0.replace(bal=jnp.asarray(bal),
+                       x_step=jnp.zeros_like(db0.x_step),
+                       s_step=jnp.zeros_like(db0.s_step),
+                       step=jnp.asarray(next_step, np.uint32))

@@ -78,3 +78,87 @@ def append(ring: LogRing, do_append, table_id, is_del, key_hi, key_lo, ver, val)
     new_entries = ring.entries.at[safe_lane, slot].set(entry, mode="drop")
     new_head = ring.head + lane_counts
     return ring.replace(entries=new_entries, head=new_head), lane, slot
+
+
+# --------------------------------------------------------------------------
+# Replicated flat ring: the dense engines' log x3.
+#
+# The reference replicates every log append to all 3 servers (CommitLog x3,
+# tatp/caladan/client_ebpf_shard.cc:779-810) and the replicas are
+# bit-identical by construction, so the dense engines keep ONE set of slots
+# with the 3 replica entries packed side by side in the trailing word axis,
+# written by a single row-major unique-index scatter — the same scatter
+# discipline as their table installs (engines/tatp_dense.py module
+# docstring). Two measured v5e facts force this exact shape:
+#   * the [L, CAP] 2-D scatter LogRing.append pays costs ~15 ms per 16 K
+#     appends (XLA cannot prove uniqueness across the (lane, slot) index
+#     pair and serializes); a flat 1-D row scatter is ~2 ms;
+#   * a [slots, 3, EW] u32 array is tiled T(4,128) over its minor dims, so
+#     each slot physically occupies 2 KB — 34 GB at 16M slots (observed
+#     OOM). Packing replicas into the word axis pays the 128-lane padding
+#     once per slot, not once per replica.
+# Slots are flat: lane l's slots occupy rows [l*cap, (l+1)*cap).
+# --------------------------------------------------------------------------
+
+
+@flax.struct.dataclass
+class RepLog:
+    entries: jax.Array   # u32 [L*CAP, S * (HDR_WORDS + VW)]
+    head: jax.Array      # u32 [L] (monotonic; replicas identical)
+    lanes: int = flax.struct.field(pytree_node=False, default=16)
+    replicas: int = flax.struct.field(pytree_node=False, default=3)
+
+    @property
+    def entry_words(self):
+        return self.entries.shape[1] // self.replicas
+
+    @property
+    def capacity(self):
+        return self.entries.shape[0] // self.lanes
+
+
+def create_rep(lanes: int, capacity: int, val_words: int = 10,
+               replicas: int = 3) -> RepLog:
+    assert capacity & (capacity - 1) == 0
+    return RepLog(
+        entries=jnp.zeros((lanes * capacity,
+                           replicas * (HDR_WORDS + val_words)), U32),
+        head=jnp.zeros((lanes,), U32), lanes=lanes, replicas=replicas)
+
+
+def append_rep(ring: RepLog, do_append, table_id, is_del, key_hi, key_lo,
+               ver, val) -> RepLog:
+    """Batched replicated append; same slot assignment as `append` (lane =
+    round-robin, slot = head[lane] + arrival rank within the lane, rings
+    wrap). One unique-index row scatter installs all replicas."""
+    r = do_append.shape[0]
+    lanes = ring.lanes
+    cap = ring.capacity
+    idx = jnp.arange(r, dtype=I32)
+    lane = idx % lanes
+    one = do_append.astype(I32)
+    pad = (-r) % lanes
+    one_p = jnp.pad(one, (0, pad)).reshape(-1, lanes)
+    excl = jnp.cumsum(one_p, axis=0) - one_p
+    rank = excl.reshape(-1)[:r]
+    lane_counts = one_p.sum(axis=0).astype(U32)
+    pos = ring.head[lane] + rank.astype(U32)
+    slot = (pos % U32(cap)).astype(I32)
+    flat = jnp.where(do_append, lane * cap + slot, lanes * cap)
+
+    flags = (is_del.astype(U32) | (table_id.astype(U32) << U32(8)))
+    entry = jnp.concatenate(
+        [flags[:, None], key_hi[:, None], key_lo[:, None], ver[:, None],
+         val.astype(U32)], axis=1)                        # [R, HDR+VW]
+    entry3 = jnp.tile(entry, (1, ring.replicas))          # [R, S*(HDR+VW)]
+    new_entries = ring.entries.at[flat].set(entry3, mode="drop",
+                                            unique_indices=True)
+    return ring.replace(entries=new_entries, head=ring.head + lane_counts)
+
+
+def replica_entries(ring: RepLog, replica: int = 0):
+    """One replica's slots in LogRing layout [L, CAP, HDR+VW] (the recovery
+    path's input: any single surviving ring suffices)."""
+    ew = ring.entry_words
+    return ring.entries[:, replica * ew:(replica + 1) * ew].reshape(
+        ring.lanes, ring.capacity, ew)

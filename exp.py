@@ -325,7 +325,7 @@ def _store_cached_bench(tag, window_s, quick):
     wave()     # compiles cache_step; queues refills for its misses
     wave()     # compiles the refill path (pending is non-empty now)
     rec.reset()
-    srv.stats.__init__()
+    srv.stats = type(srv.stats)()
     t0 = time.time()
     while time.time() - t0 < window_s:
         wave()

@@ -105,7 +105,10 @@ def test_populate_scale_is_vectorized():
     t0 = time.time()
     kvs.populate(keys, vals)
     populate_s = time.time() - t0
-    assert populate_s < 30, populate_s
+    # generous bound: the per-lane dict loop took minutes; the vectorized
+    # path takes seconds even on a loaded machine (a tight bound flakes
+    # when the suite shares cores with a TPU bench run)
+    assert populate_s < 90, populate_s
 
     probe = np.random.default_rng(0).integers(1, n + 1, 8192).astype(np.uint64)
     t0 = time.time()

@@ -7,6 +7,7 @@ import numpy as np
 import pytest
 
 from dint_tpu import recovery
+from dint_tpu.tables import log as logring
 from dint_tpu.engines import smallbank_dense as sd, tatp_dense as td
 
 VW = 4
@@ -28,12 +29,11 @@ def _run_tatp(n_sub, w, blocks, seed=0):
 def test_tatp_recovers_from_any_single_log_replica():
     n_sub = 64
     snapshot, db = _run_tatp(n_sub, w=128, blocks=4)
-    entries = np.asarray(db.log.entries)     # [3, L, CAP, W]
-    heads = np.asarray(db.log.head)          # [3, L]
+    heads = np.asarray(db.log.head)          # [L] (replicas identical)
     for replica in range(3):
         rec = recovery.recover_tatp_dense(
             jax.tree.map(jax.numpy.asarray, snapshot),
-            entries[replica], heads[replica])
+            np.asarray(logring.replica_entries(db.log, replica)), heads)
         assert np.array_equal(np.asarray(rec.val), np.asarray(db.val)), replica
         assert np.array_equal(np.asarray(rec.ver), np.asarray(db.ver))
         assert np.array_equal(np.asarray(rec.exists), np.asarray(db.exists))
@@ -56,12 +56,14 @@ def test_smallbank_recovers_and_conserves_balance():
 
     rec = recovery.recover_smallbank_dense(
         jax.tree.map(jax.numpy.asarray, snapshot),
-        np.asarray(db.log.entries)[1], np.asarray(db.log.head)[1])
-    assert np.array_equal(np.asarray(rec.val), np.asarray(db.val))
-    assert np.array_equal(np.asarray(rec.ver), np.asarray(db.ver))
+        np.asarray(logring.replica_entries(db.log, 1)),
+        np.asarray(db.log.head))
+    assert np.array_equal(np.asarray(rec.bal), np.asarray(db.bal))
     assert int(np.asarray(sd.total_balance(rec))) == \
         int(np.asarray(sd.total_balance(db)))
-    assert not np.asarray(rec.x_held).any()
+    # lock stamps reset and the step counter resumes past every logged step
+    assert int(np.asarray(rec.step)) >= int(np.asarray(db.step)) - 1
+    assert not np.asarray(rec.x_step).any()
 
 
 def test_wrapped_ring_refuses_recovery():
@@ -76,11 +78,11 @@ def test_wrapped_ring_refuses_recovery():
     for i in range(6):
         carry, _ = run(carry, jax.random.fold_in(key, i))
     db, _ = drain(carry)
-    assert (np.asarray(db.log.head)[0] > 16).any()
+    assert (np.asarray(db.log.head) > 16).any()
     with pytest.raises(ValueError, match="wrapped"):
         recovery.recover_smallbank_dense(
-            sd.create(n_acc), np.asarray(db.log.entries)[0],
-            np.asarray(db.log.head)[0])
+            sd.create(n_acc), np.asarray(logring.replica_entries(db.log, 0)),
+            np.asarray(db.log.head))
 
 
 def test_geometry_mismatch_refuses_recovery():
@@ -88,5 +90,6 @@ def test_geometry_mismatch_refuses_recovery():
     _, db = _run_tatp(64, w=128, blocks=2)
     small = td.populate(np.random.default_rng(0), 4, val_words=VW)
     with pytest.raises(ValueError, match="geometry"):
-        recovery.recover_tatp_dense(small, np.asarray(db.log.entries)[0],
-                                    np.asarray(db.log.head)[0])
+        recovery.recover_tatp_dense(
+            small, np.asarray(logring.replica_entries(db.log, 0)),
+            np.asarray(db.log.head))

@@ -4,6 +4,7 @@ import numpy as np
 
 from dint_tpu.clients import tatp_client as tc
 from dint_tpu.engines import tatp, tatp_dense as td, tatp_pipeline as tp
+from dint_tpu.tables import log as logring
 
 VW = 4
 
@@ -53,19 +54,16 @@ def test_low_contention_mostly_commits():
     assert int(total[td.STAT_MAGIC_BAD]) == 0
 
 
-def test_drain_releases_locks_and_replicas_converge():
+def test_drain_releases_locks_and_log_replicas_converge():
     db, _ = _run(n_sub=64, w=128, blocks=3, seed=3)
     assert not np.asarray(db.locked).any()
-    for arr in (db.val, db.ver, db.exists):
-        a = np.asarray(arr)   # replica axis 1
-        assert np.array_equal(a[:, 0], a[:, 1])
-        assert np.array_equal(a[:, 0], a[:, 2])
-    heads = np.asarray(db.log.head)
-    assert np.array_equal(heads[0], heads[1])
-    assert np.array_equal(heads[0], heads[2])
+    # log x3 (the physically replicated artifact): slots bit-identical
+    r0 = np.asarray(logring.replica_entries(db.log, 0))
+    assert np.array_equal(r0, np.asarray(logring.replica_entries(db.log, 1)))
+    assert np.array_equal(r0, np.asarray(logring.replica_entries(db.log, 2)))
     # sentinel row untouched
-    assert not np.asarray(db.exists)[-1].any()
-    assert (np.asarray(db.ver)[-1] == 0).all()
+    assert not bool(np.asarray(db.exists)[-1])
+    assert int(np.asarray(db.ver)[-1]) == 0
 
 
 def test_delete_only_mix_empties_cf():
@@ -74,14 +72,14 @@ def test_delete_only_mix_empties_cf():
     mix = np.array([0, 0, 0, 0, 0, 0, 100], np.float64) / 100.0
     n_sub = 4
     db0 = td.populate(np.random.default_rng(0), n_sub, val_words=VW)
-    cf0 = np.asarray(db0.exists)[10 * (n_sub + 1):-1, 0]
+    cf0 = np.asarray(db0.exists)[10 * (n_sub + 1):-1]
     assert cf0.any()
     db, total = _run(n_sub=n_sub, w=128, blocks=6, mix=mix)
-    cf1 = np.asarray(db.exists)[10 * (n_sub + 1):-1, 0]
+    cf1 = np.asarray(db.exists)[10 * (n_sub + 1):-1]
     assert not cf1.any()
     assert int(total[td.STAT_COMMITTED]) == int(cf0.sum())
     # committed deletes bumped their rows' versions past populate's 1
-    vers = np.asarray(db.ver)[10 * (n_sub + 1):-1, 0]
+    vers = np.asarray(db.ver)[10 * (n_sub + 1):-1]
     assert (vers[cf0] >= 2).all()
 
 
@@ -89,9 +87,9 @@ def test_insert_mix_fills_cf_and_versions_are_monotonic():
     mix = np.array([0, 0, 0, 0, 0, 100, 0], np.float64) / 100.0
     n_sub = 4
     db0 = td.populate(np.random.default_rng(0), n_sub, val_words=VW)
-    cf0 = np.asarray(db0.exists)[10 * (n_sub + 1):-1, 0].sum()
+    cf0 = np.asarray(db0.exists)[10 * (n_sub + 1):-1].sum()
     db, total = _run(n_sub=n_sub, w=128, blocks=6, mix=mix)
-    cf1 = np.asarray(db.exists)[10 * (n_sub + 1):-1, 0].sum()
+    cf1 = np.asarray(db.exists)[10 * (n_sub + 1):-1].sum()
     assert int(total[td.STAT_COMMITTED]) == cf1 - cf0
     assert int(total[td.STAT_MAGIC_BAD]) == 0
 
@@ -134,7 +132,7 @@ def test_matches_generic_pipelined_engine_at_low_contention():
     # per-table arrays (dense tables only; CF layouts differ by design)
     p1 = n_sub + 1
     base = td._bases(p1)
-    ver_d = np.asarray(db.ver)[:, 0]
+    ver_d = np.asarray(db.ver)
     for tid, t in ((tatp.SUBSCRIBER, stacked.sub), (tatp.SEC_SUBSCRIBER,
                    stacked.sec), (tatp.ACCESS_INFO, stacked.ai),
                    (tatp.SPECIAL_FACILITY, stacked.sf)):

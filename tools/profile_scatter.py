@@ -1,0 +1,154 @@
+"""Measure candidate table layouts' scatter/gather cost on the live backend.
+
+The dense engines' step time is dominated by a ~1.5-2 ms fixed cost per
+scatter/gather op (tools/profile_dense.py), and XLA's TPU tiling pads small
+trailing dims to (4..8, 128) — [N, 3, 14] u32 physically occupies 512 B per
+row (observed: a [16.7M, 3, 14] allocation request for 34 GB). This script
+times the layouts the engines could use so the choice is a measured fact:
+
+  row128   [N, 128] u32, scatter/gather K full rows (padding paid in HBM)
+  flat1d   [N*G] u32 interleaved fields, scatter K*G single words
+  twocol   2 x [N] u32 arrays, one scatter each
+  ref3d    [N, 3, W] u32 (current dense layout), scatter K rows
+
+Usage: python tools/profile_scatter.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+plat = os.environ.get("JAX_PLATFORMS")
+if plat:
+    jax.config.update("jax_platforms", plat)
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+K = 16384           # updated rows per step (2w at the bench's w=8192)
+ITERS = 16
+
+
+def timeit(name, fn, carry, reps=3):
+    def body(c, _):
+        return fn(c), 0
+
+    @jax.jit
+    def run(c):
+        c, _ = jax.lax.scan(body, c, None, length=ITERS)
+        return c
+
+    try:
+        carry = run(carry)
+    except Exception as e:
+        print(f"{name:40s} FAILED: {repr(e)[:120]}", flush=True)
+        return
+    np.asarray(jax.tree.leaves(carry)[0].reshape(-1)[:8])
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.time()
+        carry = run(carry)
+        np.asarray(jax.tree.leaves(carry)[0].reshape(-1)[:8])
+        best = min(best, (time.time() - t0) / ITERS)
+    print(f"{name:40s} {best * 1e3:9.3f} ms/iter", flush=True)
+
+
+def main():
+    rng = np.random.default_rng(0)
+
+    # --- TATP-scale rec: N=2.2M rows ---------------------------------------
+    n = 2_200_032
+    rows = jnp.asarray(rng.choice(n, size=K, replace=False).astype(np.int32))
+    vals128 = jnp.ones((K, 128), U32)
+
+    def s_row128(c):
+        arr, r = c
+        return (arr.at[r].set(vals128, mode="drop", unique_indices=True),
+                r + 0)
+
+    timeit("tatp row128 scatter [2.2M,128]", s_row128,
+           (jnp.zeros((n, 128), U32), rows))
+
+    def g_row128(c):
+        arr, r = c
+        g = arr[r, :16]
+        return (arr, r + (g.sum().astype(I32) * 0))
+
+    timeit("tatp row128 gather16 [2.2M,128]", g_row128,
+           (jnp.zeros((n, 128), U32), rows))
+
+    vals36 = jnp.ones((K, 36), U32)
+
+    def s_row36(c):
+        arr, r = c
+        return (arr.at[r].set(vals36, mode="drop", unique_indices=True),
+                r + 0)
+
+    timeit("tatp row36 scatter [2.2M,36]", s_row36,
+           (jnp.zeros((n, 36), U32), rows))
+
+    # --- SmallBank-scale: N=48M rows ---------------------------------------
+    m = 48_000_000
+    mrows = jnp.asarray(rng.choice(m, size=K, replace=False).astype(np.int32))
+
+    # current dense layout [N, 3, 2]
+    v32 = jnp.ones((K, 3, 2), U32)
+
+    def s_ref3d(c):
+        arr, r = c
+        return (arr.at[r].set(v32, mode="drop", unique_indices=True), r + 0)
+
+    timeit("sb [48M,3,2] row scatter", s_ref3d,
+           (jnp.zeros((m, 3, 2), U32), mrows))
+
+    # two 1-D column arrays (bal, ver), one scatter each
+    ones_k = jnp.ones((K,), U32)
+
+    def s_twocol(c):
+        bal, ver, r = c
+        bal = bal.at[r].set(ones_k, mode="drop", unique_indices=True)
+        ver = ver.at[r].set(ones_k, mode="drop", unique_indices=True)
+        return (bal, ver, r + 0)
+
+    timeit("sb 2x[48M] 1-D scatters", s_twocol,
+           (jnp.zeros((m,), U32), jnp.zeros((m,), U32), mrows))
+
+    def g_twocol(c):
+        bal, ver, r = c
+        s = (bal[r].sum() + ver[r].sum()).astype(I32) * 0
+        return (bal, ver, r + s)
+
+    timeit("sb 2x[48M] 1-D gathers", g_twocol,
+           (jnp.zeros((m,), U32), jnp.zeros((m,), U32), mrows))
+
+    # interleaved flat 1-D: 6 words per row (3 replicas x bal,ver)
+    flat_idx = (mrows[:, None] * 6 + jnp.arange(6, dtype=I32)).reshape(-1)
+    v6 = jnp.ones((K * 6,), U32)
+
+    def s_flat1d(c):
+        arr, fi = c
+        return (arr.at[fi].set(v6, mode="drop", unique_indices=True), fi + 0)
+
+    timeit("sb [288M] interleaved-word scatter", s_flat1d,
+           (jnp.zeros((m * 6,), U32), flat_idx))
+
+    # 1-D scatter sized by index count: K*6 unique single words in [48M]
+    idx6 = jnp.asarray(rng.choice(m, size=K * 6, replace=False)
+                       .astype(np.int32))
+
+    def s_1d96k(c):
+        arr, fi = c
+        return (arr.at[fi].set(jnp.ones((K * 6,), U32), mode="drop",
+                               unique_indices=True), fi + 0)
+
+    timeit("sb [48M] 1-D scatter of 96k words", s_1d96k,
+           (jnp.zeros((m,), U32), idx6))
+
+
+if __name__ == "__main__":
+    main()
