@@ -213,7 +213,12 @@ def _bench_smallbank():
         window_s=WINDOW_S,
         n_accounts=int(os.environ.get("DINT_BENCH_SB_ACCOUNTS",
                                       bench_smallbank.N_ACCOUNTS)),
-        width=WIDTH, block=BLOCK)
+        # measured on v5e: TATP peaks at w=8192 (step scales ~linearly in
+        # w) but SmallBank's 3-lane txns amortize per-step overheads
+        # further out (870k @8192 -> 1.32M @16384 -> 1.37M @32768); 16384
+        # is the knee, and the wider points pay in abort rate (17% @32768)
+        width=int(os.environ.get("DINT_BENCH_SB_WIDTH", 16384)),
+        block=BLOCK)
 
 
 def _diag_json(reason: str, detail: str):

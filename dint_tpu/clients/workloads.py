@@ -91,3 +91,20 @@ def lock_trace(rng: np.random.Generator, n_txns: int = 20_000,
         is_read = rng.random(k) < read_prop
         txns.append((keys.astype(np.int64), is_read))
     return txns
+
+
+# ------------------------------------------------------------- mix sampling
+
+
+def mix_thresholds(mix) -> np.ndarray:
+    """Cumulative u32 thresholds for sampling a txn type from one uniform
+    u32 word via `searchsorted(thresh, word, side="right")` — the
+    reference's proportion-filled workgen array
+    (store/caladan/client_caladan.cc:56-66) in closed form. Normalizes
+    `mix` (raw weights are fine, as with jax.random.choice) and clips the
+    final threshold to 0xFFFFFFFF; clamp the searchsorted result to
+    len(mix)-1 for the 2^-32 word == max edge."""
+    m = np.asarray(mix, np.float64)
+    c = np.cumsum(m / m.sum())
+    return (c * 2.0**32).astype(np.uint64).clip(0, 0xFFFFFFFF) \
+        .astype(np.uint32)

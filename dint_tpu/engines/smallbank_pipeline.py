@@ -40,6 +40,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..clients import workloads as wl
 from . import smallbank
@@ -103,23 +104,27 @@ def gen_cohort(key, w: int, n_accounts: int, hot_frac: float = wl.SB_HOT_FRAC,
     """On-device workload generation: (ttype [w], a1 [w], a2 [w]).
 
     Hot-set skew per smallbank/caladan/smallbank.h:29-50: 90% of samples in
-    the first 4% of the keyspace (skew/mix overridable for sweep ablations)."""
-    k1, k2, k3, k4, k5 = jax.random.split(key, 5)
-    ttype = jax.random.choice(
-        k1, 6, shape=(w,),
-        p=jnp.asarray(wl.SB_MIX if mix is None else mix))
+    the first 4% of the keyspace (skew/mix overridable for sweep ablations).
+
+    One `random.bits` draw feeds every field via modular reduction — the
+    reference's generators are likewise `rand() % n` (smallbank.h:29-50);
+    threefry split chains + weighted `choice` measured ~2 ms per 8192-txn
+    step on v5e."""
+    bits = jax.random.bits(key, (w, 5), U32)
+    thresh = jnp.asarray(wl.mix_thresholds(
+        wl.SB_MIX if mix is None else mix))
+    ttype = jnp.minimum(
+        jnp.searchsorted(thresh, bits[:, 0], side="right"), 5).astype(I32)
     hot_n = max(int(n_accounts * hot_frac), 1)
+    hot_cut = U32(min(int(hot_prob * 2.0**32), 0xFFFFFFFF))
 
-    def sample(kh, ku, kc):
-        hot = jax.random.randint(kh, (w,), 0, hot_n, dtype=I32)
-        uni = jax.random.randint(ku, (w,), 0, n_accounts, dtype=I32)
-        is_hot = jax.random.uniform(kc, (w,)) < hot_prob
-        return jnp.where(is_hot, hot, uni)
+    def sample(word, coin):
+        hot = (word % U32(hot_n)).astype(I32)
+        uni = (word % U32(n_accounts)).astype(I32)
+        return jnp.where(coin < hot_cut, hot, uni)
 
-    ka, kb = jax.random.split(k2)
-    kc, kd = jax.random.split(k3)
-    a1 = sample(ka, kb, k4)
-    a2 = sample(kc, kd, k5)
+    a1 = sample(bits[:, 1], bits[:, 3])
+    a2 = sample(bits[:, 2], bits[:, 4])
     a2 = jnp.where(a1 == a2, (a2 + 1) % n_accounts, a2)
     return ttype, a1, a2
 

@@ -40,6 +40,7 @@ import functools
 import flax.struct
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..clients import workloads as wl
 from . import tatp
@@ -88,19 +89,29 @@ def _merge(owner, stacked):
 def gen_cohort(key, w: int, n_sub: int, mix=None):
     """On-device workload generation (tatp/caladan/tatp.h:40-63).
 
+    One `random.bits` draw feeds every field via modular reduction — the
+    same arithmetic the reference's generators use (`rand() % n`,
+    tatp/caladan/tatp.h:40-43); the txn type comes from a searchsorted
+    over the cumulative mix, which is exactly the reference's
+    proportion-filled workgen array (store/caladan/client_caladan.cc:56-66)
+    in closed form. 4 threefry splits + a weighted `choice` measured
+    ~2.3 ms per 8192-txn step on v5e — 40% of the whole fused step — and
+    this is ~6x cheaper.
+
     Returns (ttype [w], lane ops/tbl/keys [w, K], write-slot arrays [w, 2]).
     """
-    k1, k2, k3, k4 = jax.random.split(key, 4)
-    ttype = jax.random.choice(
-        k1, 7, shape=(w,),
-        p=jnp.asarray(wl.TATP_MIX if mix is None else mix))
+    bits = jax.random.bits(key, (w, 4), U32)
+    thresh = jnp.asarray(wl.mix_thresholds(
+        wl.TATP_MIX if mix is None else mix))
+    ttype = jnp.minimum(
+        jnp.searchsorted(thresh, bits[:, 0], side="right"), 6).astype(I32)
     # NURand: ((x | y) % n) + 1
-    x = jax.random.randint(k2, (w,), 0, wl.TATP_A + 1, dtype=I32)
-    y = jax.random.randint(k3, (w,), 1, n_sub + 1, dtype=I32)
+    x = (bits[:, 1] % U32(wl.TATP_A + 1)).astype(I32)
+    y = (bits[:, 2] % U32(n_sub)).astype(I32) + 1
     s_id = ((x | y) % n_sub) + 1
-    kx = jax.random.randint(k4, (w, 2), 0, 12, dtype=I32)
-    xtype = kx[:, 0] % 4 + 1                  # ai_type / sf_type 1..4
-    stime = (kx[:, 1] % 3) * 8                # 0 / 8 / 16
+    kx = bits[:, 3]
+    xtype = (kx % 4 + 1).astype(I32)          # ai_type / sf_type 1..4
+    stime = ((kx >> 2) % 3).astype(I32) * 8   # 0 / 8 / 16
 
     sf_idx = s_id * 4 + (xtype - 1)
     ai_idx = sf_idx

@@ -1,0 +1,105 @@
+"""Feasibility probe: serial scalar scatter into a VMEM-resident table.
+
+The dense engines' step time is a sum of ~9 XLA random-access ops at
+~0.3-0.5 ms each (tools/profile_dense.py). A single Pallas kernel holding
+the 8.8 MB meta array in VMEM and applying all lane ops with a scalar loop
+would collapse those — IF Mosaic's dynamic scalar access into tiled VMEM
+is cheap. This measures exactly that primitive: K scalar read-modify-
+writes at dynamic indices into an [N] u32 table, against the XLA scatter
+doing the same work.
+
+Usage: python tools/profile_pallas.py
+"""
+from __future__ import annotations
+
+import functools
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+plat = os.environ.get("JAX_PLATFORMS")
+if plat:
+    jax.config.update("jax_platforms", plat)
+
+N = 2_200_064          # meta-table rows (tatp bench scale), 128-aligned
+K = 16_384             # lane ops per step
+ITERS = 8
+C = 512                # table folded to [N // C, C] (pallas wants >=2D)
+
+
+def kernel(idx_ref, val_ref, tab_ref, out_ref):
+    out_ref[:] = tab_ref[:]
+
+    def body(i, _):
+        r = idx_ref[i, 0]
+        v = jnp.full((1, 1), val_ref[i, 0], jnp.uint32)
+        out_ref[pl.ds(r // C, 1), pl.ds(r % C, 1)] = v
+        return 0
+
+    jax.lax.fori_loop(0, K, body, 0)
+
+
+@jax.jit
+def pallas_scatter(tab, idx, val):
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(tab.shape, tab.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+    )(idx, val, tab)
+
+
+def timeit(name, fn, *args):
+    @jax.jit
+    def run(c):
+        def body(cc, _):
+            tab, i, v = cc
+            return (fn(tab, i, v), i, v), 0
+
+        c2, _ = jax.lax.scan(body, c, None, length=ITERS)
+        return c2
+
+    try:
+        c = run(args)
+    except Exception as e:
+        print(f"{name:28s} FAILED: {repr(e)[:300]}", flush=True)
+        return
+    np.asarray(jax.tree.leaves(c)[0].reshape(-1)[:8])
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.time()
+        c = run(c)
+        np.asarray(jax.tree.leaves(c)[0].reshape(-1)[:8])
+        best = min(best, (time.time() - t0) / ITERS)
+    print(f"{name:28s} {best * 1e3:9.3f} ms/iter", flush=True)
+
+
+def main():
+    rng = np.random.default_rng(0)
+    tab2d = jnp.zeros((N // C, C), jnp.uint32)
+    idx = jnp.asarray(rng.choice(N, K, replace=False).astype(np.int32)
+                      .reshape(K, 1))
+    val = jnp.asarray(rng.integers(0, 1 << 30, K, dtype=np.int64)
+                      .astype(np.uint32).reshape(K, 1))
+
+    timeit("pallas scalar scatter", pallas_scatter, tab2d, idx, val)
+
+    tab1d = jnp.zeros((N,), jnp.uint32)
+    idxf = idx.reshape(-1)
+    valf = val.reshape(-1)
+
+    def xla_scatter(tab, i, v):
+        return tab.at[i].set(v, mode="drop", unique_indices=True)
+
+    timeit("xla 1-D scatter", xla_scatter, tab1d, idxf, valf)
+
+
+if __name__ == "__main__":
+    main()
