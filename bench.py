@@ -161,6 +161,13 @@ def _child_main():
         "mode": "device_fused_pipelined",
         "throughput": round(attempted / dt, 1),
         "abort_rate": round(1 - committed / max(attempted, 1), 5),
+        # aborts from lock/validate conflicts only: the number comparable
+        # to the reference's abort rate. ab_missing is TATP semantics
+        # (GET_NEW_DEST's ~62% miss rate, insert-exists, absent CF rows)
+        # and dominates abort_rate at every contention level.
+        "contention_abort_rate": round(
+            float(total[td.STAT_AB_LOCK] + total[td.STAT_AB_VALIDATE])
+            / max(attempted, 1), 5),
         "ab_lock": int(total[td.STAT_AB_LOCK]),
         "ab_missing": int(total[td.STAT_AB_MISSING]),
         "ab_validate": int(total[td.STAT_AB_VALIDATE]),

@@ -137,13 +137,17 @@ class DenseDB:
 
 
 def create(n_sub: int, val_words: int = 10, log_lanes: int = 16,
-           log_capacity: int = 1 << 16) -> DenseDB:
+           log_capacity: int = 1 << 16,
+           log_replicas: int = N_SHARDS) -> DenseDB:
+    """``log_replicas``: the single-chip engine packs the log x3 locally;
+    the multi-chip path (parallel/dense_sharded.py) passes 1 because the
+    3 copies live on 3 devices there."""
     n1 = n_rows(n_sub) + 1
     return DenseDB(
         val=jnp.zeros((n1, val_words), U32),
         meta=jnp.zeros((n1,), U32),
         log=logring.create_rep(log_lanes, log_capacity, val_words,
-                               replicas=N_SHARDS),
+                               replicas=log_replicas),
     )
 
 
@@ -242,14 +246,33 @@ def _stats_of(c: DenseCtx):
         c.ab_lock, c.ab_missing, c.ab_validate, c.magic_bad])
 
 
+@flax.struct.dataclass
+class Installs:
+    """Wave-3 install record of one step: what a backup replica must apply
+    (parallel/dense_sharded.py ppermutes this to the +1/+2 devices — the
+    reference's CommitBck x2 + CommitLog fan-out,
+    client_ebpf_shard.cc:779-900). Rows are the emitting device's local
+    ids; wmask marks real writes (releases are lock-only and stay local)."""
+    wmask: jax.Array     # bool [2w]
+    rows: jax.Array      # i32 [2w]
+    meta: jax.Array      # u32 [2w]  new ver<<2|exists<<1 (lock bit clear)
+    val: jax.Array       # u32 [2w, VW]
+    tbl: jax.Array       # i32 [2w]  (for the log)
+    key: jax.Array       # u32 [2w]
+    is_del: jax.Array    # i32 [2w]
+    ver: jax.Array       # u32 [2w]
+
+
 def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
-              n_sub: int, val_words: int, gen_new: bool = True, mix=None):
+              n_sub: int, val_words: int, gen_new: bool = True, mix=None,
+              emit_installs: bool = False):
     """One fused device step: commit wave of c2, validate wave of c1, and
     read+lock wave of a NEW cohort — ordered commits -> reads -> locks per
     row exactly like the generic engine's phase order (engines/tatp.
     _dense_step), so cohort t-2's installs are visible to t-1's validation
     and this step's reads, and its unlocks free rows for this step's lock
-    acquires. Returns (db', new_ctx, c1', stats-of-c2)."""
+    acquires. Returns (db', new_ctx, c1', stats-of-c2), plus the Installs
+    record when ``emit_installs`` (static) is set."""
     p1 = n_sub + 1
     n1 = n_rows(n_sub) + 1
     sent = n1 - 1     # sentinel row: gathered by NOP lanes, never written
@@ -371,6 +394,13 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
         magic_bad=magic_bad)
 
     db = db.replace(val=val, meta=meta, log=logs)
+    if emit_installs:
+        inst = Installs(
+            wmask=wmask, rows=c2.ws_rows.reshape(-1),
+            meta=jnp.where(wmask, meta_new, U32(0)),
+            val=newval, tbl=log_tbl, key=log_key,
+            is_del=flags_del, ver=newver)
+        return db, new_ctx, c1, _stats_of(c2), inst
     return db, new_ctx, c1, _stats_of(c2)
 
 

@@ -40,7 +40,6 @@ import functools
 import flax.struct
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from ..clients import workloads as wl
 from . import tatp

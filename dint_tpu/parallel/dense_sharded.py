@@ -1,0 +1,222 @@
+"""Multi-chip dense TATP: partitioned subscribers + ICI replication.
+
+Scales the flagship dense engine (engines/tatp_dense.py) across a device
+mesh the way the reference scales across its 3 servers — but re-partitioned
+TPU-first. The reference shards each table independently by `key % 3`
+(tatp/caladan/client_ebpf_shard.cc:636-641), so one transaction's messages
+fan out to several servers and the client pays multi-server RTTs. Every
+TATP table, however, is keyed by the subscriber id (sf_idx = s_id*4+t,
+cf_key = s_id*12+..., tatp/caladan/tatp.h:28), so partitioning by
+SUBSCRIBER makes every transaction device-local by construction — the
+cross-device traffic that remains is exactly the replication the reference
+pays too:
+
+  * device d runs the full fused 3-wave pipeline on its local subscriber
+    range (its own on-device workload generator, locks, OCC validation);
+  * each step's install record (engines/tatp_dense.Installs) is forwarded
+    to devices d+1 and d+2 with `ppermute` over ICI — the reference's
+    CommitBck x2 (client_ebpf_shard.cc:812-860) — and applied there to
+    backup tables;
+  * the receivers ALSO append the forwarded records to their own log
+    rings, so every write lands in 3 devices' logs — the reference's
+    CommitLog x3 (:779-810), now real cross-device replicated logging
+    (the single-chip engine's RepLog packs 3 replica entries locally
+    instead);
+  * per-step stats are `psum`med across the mesh — batched 2PC vote
+    collection.
+
+Backup tables use the tight interleaved 1-D layout ([rows * VW] words)
+rather than the primary's padded [rows, VW]: XLA pads trailing dims to 128
+lanes, and at the reference's 7M-subscriber scale the backup copies are
+what pushes per-device HBM over the edge (SURVEY.md §6; two backup ranges
+per device). Backups hold val + ver:exists only — locks are volatile
+primary-side state, exactly like the reference's backup servers.
+
+Runs under one jitted shard_map step; tested on the virtual 8-device CPU
+mesh and exercised by __graft_entry__.dryrun_multichip.
+"""
+from __future__ import annotations
+
+import functools
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..engines import tatp_dense as td
+from ..tables import log as logring
+from .sharded import SHARD_AXIS, make_mesh   # noqa: F401 (re-exported)
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+N_BCK = 2      # backup copies per row range (reference: 3 replicas total)
+
+
+@flax.struct.dataclass
+class ShardState:
+    """One device's slice: a full single-chip DenseDB for its subscriber
+    range + tight backup copies of the two predecessor devices' ranges
+    (slot 0 = device d-1's rows, slot 1 = d-2's)."""
+    db: td.DenseDB
+    bck_val: jax.Array    # u32 [N_BCK * n1_loc * VW]  interleaved words
+    bck_meta: jax.Array   # u32 [N_BCK * n1_loc]       ver<<1 | exists
+
+
+def n_sub_local(n_sub_global: int, n_shards: int) -> int:
+    return (n_sub_global + n_shards - 1) // n_shards
+
+
+def create_sharded(mesh: Mesh, n_shards: int, n_sub_global: int,
+                   val_words: int = 10, seed: int = 0,
+                   **kw) -> ShardState:
+    """Stacked per-device state sharded over the mesh (leading axis =
+    device). Population matches the single-chip engine per local range
+    (reference populate, client_ebpf_shard.cc:96-341)."""
+    n_loc = n_sub_local(n_sub_global, n_shards)
+    n1 = td.n_rows(n_loc) + 1
+
+    # log_replicas=1: the 3 log copies live on 3 devices here (forwarded
+    # installs are appended by each receiver), not packed per-slot
+    dbs = [td.populate(np.random.default_rng(seed + d), n_loc,
+                       val_words=val_words, log_replicas=1, **kw)
+           for d in range(n_shards)]
+    db = jax.tree.map(lambda *xs: jnp.stack(xs), *dbs)
+    # backups start as copies of the predecessors' populated tables
+    val1d = jnp.stack([d_.val[:-1].reshape(-1) for d_ in dbs])  # [D, n1-1*VW]
+    meta1 = jnp.stack([d_.meta[:-1] >> 1 for d_ in dbs])        # [D, n1-1]
+
+    def pred(x, off):
+        return jnp.roll(x, off, axis=0)     # device d gets device d-off's copy
+
+    pad_v = jnp.zeros((n_shards, val_words), U32)   # sentinel row padding
+    pad_m = jnp.zeros((n_shards, 1), U32)
+    bck_val = jnp.concatenate([pred(val1d, 1), pad_v,
+                               pred(val1d, 2), pad_v], axis=1)
+    bck_meta = jnp.concatenate([pred(meta1, 1), pad_m,
+                                pred(meta1, 2), pad_m], axis=1)
+
+    state = ShardState(db=db, bck_val=bck_val, bck_meta=bck_meta)
+    shard = NamedSharding(mesh, P(SHARD_AXIS))
+    return jax.tree.map(lambda x: jax.device_put(x, shard), state)
+
+
+def _apply_backup(state: ShardState, inst: td.Installs, slot: int,
+                  n1: int, val_words: int):
+    """Install a forwarded record into backup copy `slot` + log it locally
+    (the backup server's COMMIT_BCK + COMMIT_LOG handling,
+    tatp/ebpf/shard_kern.c:659-939)."""
+    base = slot * n1
+    oob = N_BCK * n1
+    rows = jnp.where(inst.wmask, base + inst.rows, oob)
+    meta = state.bck_meta.at[rows].set(inst.meta >> 1, mode="drop",
+                                       unique_indices=True)
+    # masked lanes ride the oob row: oob*val_words is already past the end
+    flat = (rows[:, None] * val_words
+            + jnp.arange(val_words, dtype=I32)).reshape(-1)
+    val = state.bck_val.at[flat].set(inst.val.reshape(-1), mode="drop",
+                                     unique_indices=True)
+    log = logring.append_rep(state.db.log, inst.wmask, inst.tbl,
+                             inst.is_del, jnp.zeros_like(inst.key),
+                             inst.key, inst.ver, inst.val)
+    return state.replace(bck_val=val, bck_meta=meta,
+                         db=state.db.replace(log=log))
+
+
+def build_sharded_pipelined_runner(mesh: Mesh, n_shards: int,
+                                   n_sub_global: int, w: int = 4096,
+                                   val_words: int = 10,
+                                   cohorts_per_block: int = 8, mix=None):
+    """jit(shard_map(scan(step)))) over stacked carry. Same contract shape
+    as the single-chip runner: returns (run, init, drain) where
+      run(carry, key) -> (carry', stats [cohorts_per_block, N_STATS]
+                          psummed across the mesh)
+      init(state)     -> carry with two bootstrap cohorts per device
+      drain(carry)    -> (state, stats [2, N_STATS]) flushing pipelines
+    """
+    n_loc = n_sub_local(n_sub_global, n_shards)
+    n1 = td.n_rows(n_loc) + 1
+    kw = dict(w=w, n_sub=n_loc, val_words=val_words)
+
+    def local_step(state, c1, c2, key, gen_new=True):
+        dev = jax.lax.axis_index(SHARD_AXIS)
+        db, new_ctx, c1, stats, inst = td.pipe_step(
+            state.db, c1, c2, jax.random.fold_in(key, dev), mix=mix,
+            gen_new=gen_new, emit_installs=True, **kw)
+        state = state.replace(db=db)
+        # constants born inside the body (attempted, ab_validate=0) are
+        # unvarying over the mesh axis; mark them varying so the scan
+        # carry types close under shard_map
+        def vary(x):
+            if SHARD_AXIS in getattr(jax.typeof(x), "vma", ()):
+                return x
+            return jax.lax.pcast(x, SHARD_AXIS, to="varying")
+
+        new_ctx, c1 = jax.tree.map(vary, (new_ctx, c1))
+        # CommitBck + CommitLog fan-out: forward installs to d+1, d+2
+        for off in (1, 2):
+            perm = [(i, (i + off) % n_shards) for i in range(n_shards)]
+            fwd = jax.tree.map(functools.partial(
+                jax.lax.ppermute, axis_name=SHARD_AXIS, perm=perm), inst)
+            state = _apply_backup(state, fwd, off - 1, n1, val_words)
+        return state, new_ctx, c1, jax.lax.psum(stats, SHARD_AXIS)
+
+    def scan_fn(carry, key, gen_new=True):
+        state, c1, c2 = carry
+        state, new_ctx, c1, stats = local_step(state, c1, c2, key, gen_new)
+        return (state, new_ctx, c1), stats
+
+    def sq(tree):
+        return jax.tree.map(lambda x: x[0], tree)
+
+    def unsq(tree):
+        return jax.tree.map(lambda x: x[None], tree)
+
+    def block_local(state_blk, c1_blk, c2_blk, key):
+        keys = jax.random.split(key, cohorts_per_block)
+        carry, stats = jax.lax.scan(
+            scan_fn, (sq(state_blk), sq(c1_blk), sq(c2_blk)), keys)
+        state, c1, c2 = carry
+        return unsq(state), unsq(c1), unsq(c2), stats
+
+    def drain_local(state_blk, c1_blk, c2_blk, key):
+        carry = (sq(state_blk), sq(c1_blk), sq(c2_blk))
+        carry, s1 = scan_fn(carry, key, gen_new=False)
+        carry, s2 = scan_fn(carry, jax.random.fold_in(key, 1),
+                            gen_new=False)
+        state, _, _ = carry
+        return unsq(state), jnp.stack([s1, s2])
+
+    spec = (P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS), P())
+    block = jax.shard_map(block_local, mesh=mesh, in_specs=spec,
+                          out_specs=(P(SHARD_AXIS), P(SHARD_AXIS),
+                                     P(SHARD_AXIS), P()))
+    drain_m = jax.shard_map(drain_local, mesh=mesh, in_specs=spec,
+                            out_specs=(P(SHARD_AXIS), P()))
+
+    def stack_ctx():
+        shard = NamedSharding(mesh, P(SHARD_AXIS))
+        one = td.empty_ctx(w)
+        return jax.tree.map(
+            lambda x: jax.device_put(
+                jnp.broadcast_to(x[None], (n_shards,) + x.shape), shard),
+            one)
+
+    jit_block = jax.jit(block, donate_argnums=(0, 1, 2))
+    jit_drain = jax.jit(drain_m, donate_argnums=(0, 1, 2))
+
+    def run(carry, key):
+        state, c1, c2 = carry
+        state, c1, c2, stats = jit_block(state, c1, c2, key)
+        return (state, c1, c2), stats
+
+    def init(state):
+        return (state, stack_ctx(), stack_ctx())
+
+    def drain(carry):
+        state, c1, c2 = carry
+        return jit_drain(state, c1, c2, jax.random.PRNGKey(0))
+
+    return run, init, drain

@@ -182,6 +182,27 @@ def _sb_extras(total):
     }
 
 
+def run_point(results, name, fn, attempts=2, backoff_s=30):
+    """Run one sweep point with per-point fault tolerance: the axon tunnel
+    can drop mid-sweep (observed: remote_compile connection refused 75 min
+    in, voiding every result), so a failed point retries once after a
+    backoff and then records an error artifact instead of killing the
+    sweep. Returns True if the point produced a measurement."""
+    err = "unknown"
+    for attempt in range(attempts):
+        if attempt:
+            time.sleep(backoff_s)
+        try:
+            results[name] = fn()
+            return True
+        except Exception as e:      # noqa: BLE001 - record-and-continue
+            err = repr(e)[:300]
+            print(f"point {name} attempt {attempt + 1} failed: {err}",
+                  flush=True)
+    results[name] = {"error": err}
+    return False
+
+
 def _metric_json(att, com, dt, p, extra):
     from dint_tpu.stats import MetricBlock
 
@@ -197,32 +218,56 @@ def sweep_pipeline(name, runner_fn, extras_fn, n_stats, *, widths, cpb,
     width relative to its measured peak."""
     peak = None
     peak_w = None
+
+    def closed_point(w):
+        def fn():
+            nonlocal peak, peak_w
+            run, carry, drain = runner_fn(w, cpb)
+            total, dt, p, cores = pipeline_closed(
+                run, carry, drain, n_stats, window_s=window_s, cpb=cpb,
+                depth=depth, magic_idx=magic_idx)
+            att, com, extra = extras_fn(total)
+            extra.update(cores)
+            extra["mode"] = "closed"
+            extra["width"] = w
+            if peak is None or att / dt > peak:
+                peak, peak_w = att / dt, w
+            return _metric_json(att, com, dt, p, extra)
+
+        return fn
+
     for w in widths:
-        run, carry, drain = runner_fn(w, cpb)
-        total, dt, p, cores = pipeline_closed(run, carry, drain, n_stats,
-                                              window_s=window_s, cpb=cpb,
-                                              depth=depth,
-                                              magic_idx=magic_idx)
-        att, com, extra = extras_fn(total)
-        extra.update(cores)
-        extra["mode"] = "closed"
-        extra["width"] = w
-        results[f"{name}_closed_w{w}"] = _metric_json(att, com, dt, p, extra)
-        if peak is None or att / dt > peak:
-            peak, peak_w = att / dt, w
+        run_point(results, f"{name}_closed_w{w}", closed_point(w))
+    if peak is None:      # no closed point survived: no rate anchor
+        return
+
+    def open_point(frac):
+        def fn():
+            rate = max(peak * frac, 1.0)
+            total, dt, p, offered, _ = pipeline_open(
+                lambda: runner_fn(peak_w, cpb), n_stats, rate=rate,
+                window_s=window_s, w=peak_w, cpb=cpb, depth=depth)
+            att, com, extra = extras_fn(total)
+            extra.update(mode="open", width=peak_w,
+                         target_rate=round(rate, 1),
+                         offered_rate=round(offered, 1),
+                         load_frac=frac)
+            return _metric_json(att, com, dt, p, extra)
+
+        return fn
 
     for frac in open_rates:
-        rate = max(peak * frac, 1.0)
-        total, dt, p, offered, _ = pipeline_open(
-            lambda: runner_fn(peak_w, cpb), n_stats, rate=rate,
-            window_s=window_s, w=peak_w, cpb=cpb, depth=depth)
-        att, com, extra = extras_fn(total)
-        extra.update(mode="open", width=peak_w,
-                     target_rate=round(rate, 1),
-                     offered_rate=round(offered, 1),
-                     load_frac=frac)
-        results[f"{name}_open_{int(frac * 100)}pct"] = _metric_json(
-            att, com, dt, p, extra)
+        run_point(results, f"{name}_open_{int(frac * 100)}pct",
+                  open_point(frac))
+
+
+def _timed_client(client, go, window_s):
+    go()                             # compile
+    client.rec.reset()
+    t0 = time.time()
+    while time.time() - t0 < window_s:
+        go()
+    return client.rec.block(time.time() - t0).to_dict()
 
 
 def sweep_micro(window_s, quick, results, want=lambda name: True):
@@ -239,22 +284,29 @@ def sweep_micro(window_s, quick, results, want=lambda name: True):
     def timed(name, client, go):
         if not want(name):
             return
-        go()                         # compile
-        client.rec.reset()
-        t0 = time.time()
-        while time.time() - t0 < window_s:
-            go()
-        results[name] = client.rec.block(time.time() - t0).to_dict()
+
+        def fn():
+            go()                     # compile
+            client.rec.reset()
+            t0 = time.time()
+            while time.time() - t0 < window_s:
+                go()
+            return client.rec.block(time.time() - t0).to_dict()
+
+        run_point(results, name, fn)
 
     for read_frac, tag in ((0.5, "contention"), (1.0, "parallel")):
         for w in widths:
             name = f"store_{tag}_w{w}"
             if not want(name):
                 continue
-            c = micro.StoreClient.populated(n_keys, width=w,
-                                            read_frac=read_frac)
-            timed(name, c, lambda: c.run_wave(rng))
-            results[name] = results[name] | {"width": w}
+            def store_fn(w=w, read_frac=read_frac):
+                c = micro.StoreClient.populated(n_keys, width=w,
+                                                read_frac=read_frac)
+                return _timed_client(c, lambda: c.run_wave(rng),
+                                     window_s) | {"width": w}
+
+            run_point(results, name, store_fn)
 
     if any(want(n) for n in ("lock_2pl", "lock_fasst", "lock_fasst_attr")):
         trace = wl.lock_trace(rng, n_txns=200 if quick else 20_000,
@@ -273,12 +325,15 @@ def sweep_micro(window_s, quick, results, want=lambda name: True):
         timed("log_server", c, lambda: c.run_wave(rng))
 
     if want("store_wire"):
-        results["store_wire"] = _store_wire_bench(window_s, quick)
+        run_point(results, "store_wire",
+                  lambda: _store_wire_bench(window_s, quick))
 
     for tag in ("wb_bloom", "wb_nobloom", "wt"):
         name = f"store_cached_{tag}"
         if want(name):
-            results[name] = _store_cached_bench(tag, window_s, quick)
+            run_point(results, name,
+                      lambda tag=tag: _store_cached_bench(tag, window_s,
+                                                          quick))
 
 
 def _store_cached_bench(tag, window_s, quick):

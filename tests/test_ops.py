@@ -149,3 +149,22 @@ def test_cpu_monitor_measures_busy_host():
     assert cores["host_ucores"] >= cores["proc_ucores"] - 0.2
     for v in cores.values():
         assert v >= 0
+
+
+def test_mix_thresholds_normalizes_raw_weights():
+    """Raw (unnormalized) weights must sample the same distribution as
+    fractions — jax.random.choice normalized internally, and the
+    closed-form sampler must too (sweep ablations pass raw mixes)."""
+    from dint_tpu.clients import workloads as wl
+
+    frac = wl.mix_thresholds(np.asarray(wl.TATP_MIX))
+    raw = wl.mix_thresholds(np.asarray(wl.TATP_MIX) * 100.0)
+    assert np.array_equal(frac, raw)
+    assert frac[-1] == 0xFFFFFFFF
+    # empirical check: 1M words land within 0.5% of each target fraction
+    words = np.random.default_rng(0).integers(0, 1 << 32, 1_000_000,
+                                              dtype=np.uint64)
+    t = np.minimum(np.searchsorted(frac, words, side="right"),
+                   len(frac) - 1)
+    got = np.bincount(t, minlength=len(frac)) / len(words)
+    assert np.abs(got - np.asarray(wl.TATP_MIX)).max() < 0.005
