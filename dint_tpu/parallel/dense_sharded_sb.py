@@ -1,0 +1,387 @@
+"""Multi-chip dense SmallBank: cross-device transactions over ICI.
+
+Unlike TATP (every table keys by subscriber id, so parallel/
+dense_sharded.py makes txns device-local by re-partitioning), SmallBank's
+Amalgamate/SendPayment touch TWO accounts that land on different shards no
+matter how the keyspace is cut (smallbank/caladan/client_ebpf_shard.cc:255,
+830) — the reference's coordinator fans each transaction's lock/commit
+messages to up to 3 servers and pays a network RTT per wave. This module
+is that distributed transaction structure as ICI collectives:
+
+  wave 1 of step T (cohort t):
+    * every device generates w txns over the GLOBAL keyspace (accounts
+      round-robin partitioned: owner = account % D, so the 4% hot set
+      spreads across all devices);
+    * lock+read requests are compacted per owner and exchanged with ONE
+      `all_to_all` (the reference's per-shard request batches,
+      client_ebpf_shard.cc:287-325, as one collective instead of D
+      socket fan-outs);
+    * owners arbitrate no-wait S/X grants against their local step-stamp
+      tables (same closed form as engines/smallbank_dense.py) and serve
+      the fused balance read; replies return with a second `all_to_all`;
+    * the source device classifies outcomes and runs the shared
+      compute_phase.
+
+  wave 2 of step T+1 (cohort t installs):
+    * committed writes are routed to owners the same way and installed;
+    * each owner forwards its applied installs to devices owner+1/owner+2
+      with `ppermute`, which update their backup copies and append their
+      own logs — CommitBck x2 + CommitLog x3
+      (client_ebpf_shard.cc:779-860);
+    * stats are `psum`med: batched 2PC vote collection.
+
+Locks are held across exactly one step boundary (stamps expire), so
+cross-device lock conflicts between consecutive cohorts are real, like
+the single-chip dense engine — but here the conflicting txns live on
+different devices.
+
+Static-shape routing: per-destination capacity is 2x the uniform share
+(`cap = 2 * ceil(w*L/D)`); lanes that overflow a destination bucket are
+counted as lock rejects (the reference client's retry under overload —
+here a no-wait reject, bounded by the slack). Round-robin partitioning
+keeps destinations near-uniform even under the 90%/4% hot skew, so
+overflow is zero at configured widths (asserted in tests).
+
+Balance conservation holds GLOBALLY: psummed STAT_BAL_DELTA must equal
+the delta of the all-device balance sum — checked in tests; a
+cross-device install bug cannot hide.
+"""
+from __future__ import annotations
+
+import functools
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..engines.smallbank_pipeline import (L, TS_AMT_MAX, VW, N_STATS,
+                                          STAT_ATTEMPTED, STAT_COMMITTED,
+                                          STAT_AB_LOCK, STAT_AB_LOGIC,
+                                          STAT_BAL_DELTA, compute_phase,
+                                          gen_cohort, _lock_slots)
+from ..engines.types import Op
+from ..tables import log as logring
+from .sharded import SHARD_AXIS, make_mesh   # noqa: F401 (re-exported)
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+BIG = jnp.int32(1 << 30)
+N_BCK = 2
+AXIS = SHARD_AXIS
+
+
+@flax.struct.dataclass
+class SBShard:
+    """One device's slice: primary balances for its account range, backup
+    copies of the two predecessors' ranges, step-stamp lock tables, log."""
+    bal: jax.Array       # u32 [m1_loc]  (sentinel last)
+    bck_bal: jax.Array   # u32 [N_BCK * m1_loc]
+    x_step: jax.Array    # u32 [m1_loc]
+    s_step: jax.Array    # u32 [m1_loc]
+    step: jax.Array      # u32 scalar (starts at 2, == single-chip engine)
+    log: logring.RepLog  # replicas=1: the 3 copies live on 3 devices
+
+
+def n_acct_local(n_accounts: int, d: int) -> int:
+    return (n_accounts + d - 1) // d
+
+
+def m1_local(n_accounts: int, d: int) -> int:
+    return 2 * n_acct_local(n_accounts, d) + 1
+
+
+def create_sharded_sb(mesh: Mesh, n_shards: int, n_accounts: int,
+                      init_balance: int = 1000, log_lanes: int = 16,
+                      log_capacity: int = 1 << 16) -> SBShard:
+    m1 = m1_local(n_accounts, n_shards)
+    bal = jnp.full((m1,), np.uint32(init_balance), U32).at[-1].set(0)
+    one = SBShard(
+        bal=bal,
+        bck_bal=jnp.concatenate([bal, bal]),
+        x_step=jnp.zeros((m1,), U32),
+        s_step=jnp.zeros((m1,), U32),
+        step=jnp.asarray(2, U32),
+        log=logring.create_rep(log_lanes, log_capacity, VW, replicas=1))
+    shard = NamedSharding(mesh, P(AXIS))
+    return jax.tree.map(
+        lambda x: jax.device_put(
+            jnp.broadcast_to(x[None], (n_shards,) + x.shape), shard), one)
+
+
+def total_balance_global(state: SBShard):
+    """Host-side: global balance sum over all primaries (i32 wraparound,
+    matching STAT_BAL_DELTA accounting)."""
+    bal = np.asarray(state.bal)            # [D, m1]
+    return int(bal[:, :-1].astype(np.uint32).view(np.int32)
+               .sum(dtype=np.int32))
+
+
+def _route(dest, pos, valid, cap, n_shards, fields):
+    """Scatter per-lane fields into [D*cap] destination buckets (flat
+    index dest*cap + pos; invalid lanes drop out of bounds). Returns the
+    list of routed [D*cap] arrays."""
+    idx = jnp.where(valid, dest * cap + pos, n_shards * cap)
+    return [jnp.zeros((n_shards * cap,), f.dtype)
+            .at[idx].set(f, mode="drop", unique_indices=True)
+            for f in fields]
+
+
+def _a2a(x, n_shards, cap):
+    """Exchange [D*cap] buckets: device s's bucket d lands at device d's
+    slot s."""
+    return jax.lax.all_to_all(x.reshape(n_shards, cap), AXIS, 0, 0,
+                              tiled=False).reshape(n_shards * cap)
+
+
+def _positions(dest, active, n_shards):
+    """Per-destination arrival ranks: pos[i] = #{j < i : dest j == dest i,
+    active}. One [wL, D] one-hot exclusive cumsum — no sort."""
+    oh = (dest[:, None] == jnp.arange(n_shards, dtype=I32)[None]) & \
+        active[:, None]
+    excl = jnp.cumsum(oh.astype(I32), axis=0) - oh.astype(I32)
+    return jnp.take_along_axis(excl, dest[:, None], axis=1)[:, 0]
+
+
+@flax.struct.dataclass
+class SBCtx:
+    """A cohort between cross-device lock+compute and install."""
+    acc: jax.Array       # i32 [w, L] global accounts
+    tbl: jax.Array       # i32 [w, L]
+    do_write: jax.Array  # bool [w, L]
+    nw: jax.Array        # i32 [w, L]
+    attempted: jax.Array
+    committed: jax.Array
+    ab_lock: jax.Array
+    ab_logic: jax.Array
+    magic_bad: jax.Array
+    bal_delta: jax.Array
+
+
+def _empty_sb_ctx(w: int) -> SBCtx:
+    def z(shape, dt):
+        return jnp.asarray(np.zeros(shape, dt))
+
+    return SBCtx(acc=z((w, L), np.int32), tbl=z((w, L), np.int32),
+                 do_write=z((w, L), bool), nw=z((w, L), np.int32),
+                 attempted=z((), np.int32), committed=z((), np.int32),
+                 ab_lock=z((), np.int32), ab_logic=z((), np.int32),
+                 magic_bad=z((), np.int32), bal_delta=z((), np.int32))
+
+
+def _stats_of(c: SBCtx):
+    return jnp.stack([c.attempted, c.committed, c.ab_lock, c.ab_logic,
+                      c.magic_bad, c.bal_delta])
+
+
+def build_sharded_sb_runner(mesh: Mesh, n_shards: int, n_accounts: int,
+                            w: int = 2048, cohorts_per_block: int = 8,
+                            hot_frac=None, hot_prob=None, mix=None):
+    """jit(shard_map(scan(step))). Contract mirrors the single-chip dense
+    runner: (run, init, drain); stats are psummed across the mesh."""
+    d = n_shards
+    n_loc = n_acct_local(n_accounts, d)
+    m1 = m1_local(n_accounts, d)
+    sent = m1 - 1
+    oob = m1
+    cap = 2 * ((w * L + d - 1) // d)
+    kw_gen = {}
+    if hot_frac is not None:
+        kw_gen["hot_frac"] = hot_frac
+    if hot_prob is not None:
+        kw_gen["hot_prob"] = hot_prob
+
+    def local_step(state: SBShard, c1: SBCtx, key, gen_new=True):
+        dev = jax.lax.axis_index(AXIS)
+        t = state.step
+        kgen, kamt = jax.random.split(jax.random.fold_in(key, dev))
+
+        # ---- wave 1: generate + route lock/read requests to owners ----
+        if gen_new:
+            ttype, a1, a2 = gen_cohort(kgen, w, n_accounts, mix=mix,
+                                       **kw_gen)
+            l_op, l_tb, l_ac = _lock_slots(ttype, a1, a2)
+        else:
+            ttype = jnp.zeros((w,), I32)
+            l_op = jnp.zeros((w, L), I32)
+            l_tb = jnp.zeros((w, L), I32)
+            l_ac = jnp.zeros((w, L), I32)
+        ts_amt = jax.random.randint(kamt, (w,), -TS_AMT_MAX,
+                                    TS_AMT_MAX + 1, dtype=I32)
+
+        active = (l_op != 0).reshape(-1)
+        dest = (l_ac.reshape(-1) % d).astype(I32)
+        row_loc = (l_tb.reshape(-1) * n_loc
+                   + l_ac.reshape(-1) // d).astype(I32)
+        pos = _positions(dest, active, d)
+        valid = active & (pos < cap)
+
+        r_op, r_row = _route(dest, pos, valid, cap, d,
+                             [l_op.reshape(-1), row_loc])
+        r_op = _a2a(r_op, d, cap)
+        r_row = _a2a(r_row, d, cap)
+
+        # ---- owner side: no-wait S/X arbitration + fused read ---------
+        lanes = jnp.arange(d * cap, dtype=I32)
+        is_x = r_op == Op.ACQ_X_READ
+        is_s = r_op == Op.ACQ_S_READ
+        rows = jnp.where(r_op != 0, r_row, sent)
+        first_x = jnp.full((m1,), BIG, I32).at[
+            jnp.where(is_x, rows, oob)].min(lanes, mode="drop")
+        first_s = jnp.full((m1,), BIG, I32).at[
+            jnp.where(is_s, rows, oob)].min(lanes, mode="drop")
+        held_x = state.x_step[rows] == t - 1
+        held_s = state.s_step[rows] == t - 1
+        slot_free = ~held_x & ~held_s
+        x_wins = (first_x[rows] < first_s[rows]) & slot_free
+        grant_x = is_x & x_wins & (first_x[rows] == lanes)
+        grant_s = is_s & ~held_x & ~x_wins
+        x_step = state.x_step.at[jnp.where(grant_x, rows, oob)].set(
+            t, mode="drop", unique_indices=True)
+        s_step = state.s_step.at[
+            jnp.where(grant_s & (first_s[rows] == lanes), rows, oob)].set(
+            t, mode="drop", unique_indices=True)
+        g_bal = jnp.where(grant_x | grant_s,
+                          state.bal[rows].astype(I32), 0)
+
+        # ---- replies back to sources + classify -----------------------
+        rep_g = _a2a((grant_x | grant_s), d, cap)
+        rep_b = _a2a(g_bal, d, cap)
+        back = jnp.where(valid, dest * cap + pos, 0)
+        granted = (jnp.where(valid, rep_g[back], False)
+                   .reshape(w, L))
+        bal = jnp.where(granted, rep_b[back].reshape(w, L), 0)
+        # overflowed lanes have valid=False -> granted=False, so the
+        # no-wait reject covers them (the reference client's retry
+        # under overload, here a bounded no-wait reject)
+        lock_rejected = ((l_op != 0) & ~granted).any(axis=1)
+        alive = ~lock_rejected & (l_op[:, 0] != 0)
+
+        nw, do, logic_abort, commit, committed = compute_phase(
+            ttype, bal, alive, ts_amt)
+        do_write = do & commit[:, None] & (l_op != 0)
+        bal_delta = jnp.sum(jnp.where(do_write, nw - bal, 0), dtype=I32)
+
+        new_ctx = SBCtx(
+            acc=l_ac, tbl=l_tb, do_write=do_write, nw=nw,
+            attempted=jnp.asarray(w if gen_new else 0, I32),
+            committed=committed.sum(dtype=I32),
+            ab_lock=(lock_rejected & (l_op[:, 0] != 0)).sum(dtype=I32),
+            ab_logic=logic_abort.sum(dtype=I32),
+            magic_bad=jnp.asarray(0, I32),
+            bal_delta=bal_delta)
+
+        # ---- wave 2 of c1: route installs to owners -------------------
+        wmask = c1.do_write.reshape(-1)
+        wdest = (c1.acc.reshape(-1) % d).astype(I32)
+        wrow = (c1.tbl.reshape(-1) * n_loc
+                + c1.acc.reshape(-1) // d).astype(I32)
+        wpos = _positions(wdest, wmask, d)
+        wvalid = wmask & (wpos < cap)   # cannot overflow: writes <= locks
+        i_m, i_row, i_bal, i_tbl, i_acc = _route(
+            wdest, wpos, wvalid, cap, d,
+            [wmask.astype(I32), wrow, c1.nw.reshape(-1),
+             c1.tbl.reshape(-1), c1.acc.reshape(-1)])
+        inst = [_a2a(x, d, cap) for x in (i_m, i_row, i_bal, i_tbl, i_acc)]
+        i_m, i_row, i_bal, i_tbl, i_acc = inst
+        i_mask = i_m != 0
+
+        irows = jnp.where(i_mask, i_row, oob)
+        bal_new = state.bal.at[irows].set(i_bal.astype(U32), mode="drop",
+                                          unique_indices=True)
+
+        def mk_entry(mask, row, balv, tblv, accv, ring, bck, slot):
+            rr = jnp.where(mask, slot * m1 + row, N_BCK * m1)
+            bck = bck.at[rr].set(balv.astype(U32), mode="drop",
+                                 unique_indices=True)
+            newval = jnp.zeros((mask.shape[0], VW), U32)
+            newval = newval.at[:, 0].set(balv.astype(U32))
+            stepv = jnp.broadcast_to(t, mask.shape)
+            ring = logring.append_rep(ring, mask, tblv,
+                                      jnp.zeros_like(balv),
+                                      jnp.zeros_like(balv, U32),
+                                      accv.astype(U32), stepv, newval)
+            return ring, bck
+
+        # owner logs its installs (CommitLog at the primary)
+        newval = jnp.zeros((d * cap, VW), U32).at[:, 0].set(
+            i_bal.astype(U32))
+        log = logring.append_rep(state.log, i_mask, i_tbl,
+                                 jnp.zeros_like(i_bal),
+                                 jnp.zeros_like(i_bal, U32),
+                                 i_acc.astype(U32),
+                                 jnp.broadcast_to(t, i_mask.shape), newval)
+        # CommitBck x2 + CommitLog at the backups: forward applied installs
+        bck = state.bck_bal
+        for off in (1, 2):
+            perm = [(i, (i + off) % d) for i in range(d)]
+            pp = functools.partial(jax.lax.ppermute, axis_name=AXIS,
+                                   perm=perm)
+            log, bck = mk_entry(pp(i_mask), pp(i_row), pp(i_bal),
+                                pp(i_tbl), pp(i_acc), log, bck, off - 1)
+
+        state = state.replace(bal=bal_new, bck_bal=bck, x_step=x_step,
+                              s_step=s_step, step=t + 1, log=log)
+
+        def vary(x):
+            if AXIS in getattr(jax.typeof(x), "vma", ()):
+                return x
+            return jax.lax.pcast(x, AXIS, to="varying")
+
+        new_ctx = jax.tree.map(vary, new_ctx)
+        return state, new_ctx, jax.lax.psum(_stats_of(c1), AXIS)
+
+    def scan_fn(carry, key, gen_new=True):
+        state, c1 = carry
+        state, new_ctx, stats = local_step(state, c1, key, gen_new)
+        return (state, new_ctx), stats
+
+    def sq(tree):
+        return jax.tree.map(lambda x: x[0], tree)
+
+    def unsq(tree):
+        return jax.tree.map(lambda x: x[None], tree)
+
+    def block_local(state_blk, c1_blk, key):
+        keys = jax.random.split(key, cohorts_per_block)
+        carry, stats = jax.lax.scan(scan_fn, (sq(state_blk), sq(c1_blk)),
+                                    keys)
+        state, c1 = carry
+        return unsq(state), unsq(c1), stats
+
+    def drain_local(state_blk, c1_blk, key):
+        carry, s1 = scan_fn((sq(state_blk), sq(c1_blk)), key,
+                            gen_new=False)
+        state, _ = carry
+        return unsq(state), jnp.stack([s1])
+
+    spec = (P(AXIS), P(AXIS), P())
+    block = jax.shard_map(block_local, mesh=mesh, in_specs=spec,
+                          out_specs=(P(AXIS), P(AXIS), P()))
+    drain_m = jax.shard_map(drain_local, mesh=mesh, in_specs=spec,
+                            out_specs=(P(AXIS), P()))
+    jit_block = jax.jit(block, donate_argnums=(0, 1))
+    jit_drain = jax.jit(drain_m, donate_argnums=(0, 1))
+
+    def stack_ctx():
+        shard = NamedSharding(mesh, P(AXIS))
+        one = _empty_sb_ctx(w)
+        return jax.tree.map(
+            lambda x: jax.device_put(
+                jnp.broadcast_to(x[None], (d,) + x.shape), shard), one)
+
+    def run(carry, key):
+        state, c1 = carry
+        state, c1, stats = jit_block(state, c1, key)
+        return (state, c1), stats
+
+    def init(state):
+        return (state, stack_ctx())
+
+    def drain(carry):
+        state, c1 = carry
+        return jit_drain(state, c1, jax.random.PRNGKey(0))
+
+    return run, init, drain
