@@ -54,9 +54,9 @@ BLOCK = int(os.environ.get("DINT_BENCH_BLOCK", 16))     # cohorts per dispatch
 VAL_WORDS = 10
 WINDOW_S = float(os.environ.get("DINT_BENCH_WINDOW_S", 10.0))
 
-ATTEMPTS = 3
+ATTEMPTS = 6              # observed axon outages last tens of minutes;
+BACKOFF_S = 120.0         # backoff*attempt: 30 min of patience total
 CHILD_TIMEOUT_S = 540.0   # populate + first jit compile can take minutes
-BACKOFF_S = 15.0
 PROBE_TIMEOUT_S = 90.0
 
 

@@ -89,8 +89,16 @@ class FusedState:
 
 def _layout(n_sub: int):
     p1 = n_sub + 1
+    nr = 10 * p1
+    # the u32 sort key encodes dense row ids below BIG_NOP (2^30): a
+    # bigger bank would alias dense rows into the NOP/CF key ranges and
+    # silently corrupt the segment sort (round-1 advisor finding)
+    assert S * nr < int(BIG_NOP), (
+        f"n_sub={n_sub} overflows the fused sort-key encoding "
+        f"({S * nr} rows >= {int(BIG_NOP)}); use engines/tatp_dense.py "
+        "or the sharded path at this scale")
     # offsets inside one replica's bank: SUB, SEC, AI, SF
-    return p1, 10 * p1, (0, p1, 2 * p1, 6 * p1)
+    return p1, nr, (0, p1, 2 * p1, 6 * p1)
 
 
 def create(n_sub: int, val_words: int = 10, cf_buckets: int = 1 << 15,

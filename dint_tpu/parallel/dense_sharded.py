@@ -104,10 +104,13 @@ def create_sharded(mesh: Mesh, n_shards: int, n_sub_global: int,
 
 
 def _apply_backup(state: ShardState, inst: td.Installs, slot: int,
-                  n1: int, val_words: int):
+                  n1: int, val_words: int, src_dev):
     """Install a forwarded record into backup copy `slot` + log it locally
     (the backup server's COMMIT_BCK + COMMIT_LOG handling,
-    tatp/ebpf/shard_kern.c:659-939)."""
+    tatp/ebpf/shard_kern.c:659-939). Entries log key_hi = the SOURCE
+    device: rows are source-local ids, and a log that mixes 3 devices'
+    entries must stay separable for cross-device recovery
+    (recovery.recover_tatp_dense with key_hi_filter)."""
     base = slot * n1
     oob = N_BCK * n1
     rows = jnp.where(inst.wmask, base + inst.rows, oob)
@@ -118,9 +121,12 @@ def _apply_backup(state: ShardState, inst: td.Installs, slot: int,
             + jnp.arange(val_words, dtype=I32)).reshape(-1)
     val = state.bck_val.at[flat].set(inst.val.reshape(-1), mode="drop",
                                      unique_indices=True)
+    # 1-based so "own entry" (key_hi == 0, written by pipe_step's local
+    # append) can never collide with "forwarded from device 0"
+    src = jnp.broadcast_to(src_dev.astype(U32) + U32(1), inst.key.shape)
     log = logring.append_rep(state.db.log, inst.wmask, inst.tbl,
-                             inst.is_del, jnp.zeros_like(inst.key),
-                             inst.key, inst.ver, inst.val)
+                             inst.is_del, src, inst.key, inst.ver,
+                             inst.val)
     return state.replace(bck_val=val, bck_meta=meta,
                          db=state.db.replace(log=log))
 
@@ -160,7 +166,9 @@ def build_sharded_pipelined_runner(mesh: Mesh, n_shards: int,
             perm = [(i, (i + off) % n_shards) for i in range(n_shards)]
             fwd = jax.tree.map(functools.partial(
                 jax.lax.ppermute, axis_name=SHARD_AXIS, perm=perm), inst)
-            state = _apply_backup(state, fwd, off - 1, n1, val_words)
+            src_dev = (dev - off) % n_shards
+            state = _apply_backup(state, fwd, off - 1, n1, val_words,
+                                  src_dev)
         return state, new_ctx, c1, jax.lax.psum(stats, SHARD_AXIS)
 
     def scan_fn(carry, key, gen_new=True):

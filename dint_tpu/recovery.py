@@ -19,11 +19,19 @@ import numpy as np
 from .tables.log import HDR_WORDS
 
 
-def _flat_entries(entries: np.ndarray, heads: np.ndarray):
+def _flat_entries(entries: np.ndarray, heads: np.ndarray,
+                  key_hi_filter: int | None = None):
     """Live entries of a multi-lane ring, as flat arrays.
 
     entries [L, CAP, HDR+VW] u32, heads [L] u32 (monotonic; ring wraps) ->
-    (flags, key_lo, ver, val [n, VW]) of every written slot."""
+    (flags, key_lo, ver, val [n, VW]) of every written slot.
+
+    ``key_hi_filter``: keep only entries whose key_hi word matches — the
+    sharded TATP path tags each entry's SOURCE device there (own entries
+    0, forwarded entries src+1), so one physical ring holds 3 devices'
+    separable streams (parallel/dense_sharded._apply_backup). The sharded
+    SmallBank path logs GLOBAL account ids instead, separable by
+    owner = key % n_shards (see recover_sb_shard)."""
     lanes, cap, _ = entries.shape
     if (heads.astype(np.int64) > cap).any():
         # the ring wrapped: oldest entries were overwritten, so a row whose
@@ -35,6 +43,8 @@ def _flat_entries(entries: np.ndarray, heads: np.ndarray):
     lane_of = np.repeat(np.arange(lanes), counts)
     slot_of = np.concatenate([np.arange(c) for c in counts])
     e = entries[lane_of, slot_of]
+    if key_hi_filter is not None:
+        e = e[e[:, 1] == np.uint32(key_hi_filter)]
     return e[:, 0], e[:, 2], e[:, 3], e[:, HDR_WORDS:]
 
 
@@ -49,7 +59,8 @@ def latest_per_row(rows: np.ndarray, vers: np.ndarray):
     return sr[last], order[last]
 
 
-def recover_tatp_dense(db0, log_entries, log_heads):
+def recover_tatp_dense(db0, log_entries, log_heads,
+                       key_hi_filter: int | None = None):
     """Rebuild a tatp_dense.DenseDB's table state from a base snapshot +
     ONE replica's log ring (entries/heads as numpy arrays).
 
@@ -57,14 +68,20 @@ def recover_tatp_dense(db0, log_entries, log_heads):
     fixes the table geometry; the returned DenseDB has val/ver/exists
     equal to the post-run state for every logged row. Locks are volatile
     (a recovering replica restarts with a free lock table, like the
-    reference's fresh server)."""
+    reference's fresh server).
+
+    Multi-chip (parallel/dense_sharded.py): a lost device d's primary
+    range rebuilds from its local-range snapshot plus ANY of the 3 logs
+    that carry its stream — its own (``key_hi_filter=0``) or a backup
+    holder d+1/d+2's (``key_hi_filter=d+1``, the 1-based source tag)."""
     import jax.numpy as jnp
 
     from .engines import tatp_dense as td
 
     n_sub = int(db0.n_sub)
     flags, key_lo, vers, vals = _flat_entries(np.asarray(log_entries),
-                                              np.asarray(log_heads))
+                                              np.asarray(log_heads),
+                                              key_hi_filter)
     is_del = (flags & 0xFF).astype(bool)
     table = (flags >> 8).astype(np.int64)
     p1 = n_sub + 1
@@ -89,6 +106,36 @@ def recover_tatp_dense(db0, log_entries, log_heads):
     meta[urows] = ((vers[idx].astype(np.uint32) << 2)
                    | ((~is_del[idx]).astype(np.uint32) << 1))
     return db0.replace(val=jnp.asarray(val), meta=jnp.asarray(meta))
+
+
+def recover_sb_shard(n_accounts: int, dead: int, n_shards: int,
+                     log_entries, log_heads, init_balance: int = 1000):
+    """Rebuild a lost device's PRIMARY balance range for the sharded
+    SmallBank path (parallel/dense_sharded_sb.py) from ANY of the 3 log
+    rings carrying its stream — its own or a backup holder's (each ring
+    holds its device's own installs + the two forwarded streams; entries
+    carry GLOBAL account ids, so device `dead`'s stream is
+    owner == acct % n_shards). Returns the [m1_loc] balance array
+    (u32, sentinel last) equal to the lost primary's."""
+    from .parallel.dense_sharded_sb import m1_local, n_acct_local
+
+    flags, key_lo, vers, vals = _flat_entries(np.asarray(log_entries),
+                                              np.asarray(log_heads))
+    table = (flags >> 8).astype(np.int64)
+    acct = key_lo.astype(np.int64)
+    mine = (acct % n_shards) == dead
+    table, acct, vers, vals = (table[mine], acct[mine], vers[mine],
+                               vals[mine])
+    if not ((table < 2) & (acct < n_accounts)).all():
+        raise ValueError("log key out of its table's range: the log "
+                         "belongs to a different-geometry database")
+    n_loc = n_acct_local(n_accounts, n_shards)
+    rows = table * n_loc + acct // n_shards
+    urows, idx = latest_per_row(rows, vers)
+    bal = np.full(m1_local(n_accounts, n_shards), init_balance, np.uint32)
+    bal[-1] = 0
+    bal[urows] = vals[idx][:, 0]
+    return bal
 
 
 def recover_smallbank_dense(db0, log_entries, log_heads):

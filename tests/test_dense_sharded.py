@@ -77,3 +77,41 @@ def test_backups_mirror_primaries_and_logs_replicate():
     bumps = int(sum((meta[d].astype(np.int64) >> 2).sum()
                     - vers0[d].astype(np.int64).sum() for d in range(D)))
     assert heads == 3 * bumps, (heads, bumps)
+
+
+def test_lost_device_recovers_from_any_log_stream():
+    """Device d's primary range rebuilds from its local snapshot + ANY of
+    the 3 logs carrying its stream: its own ring (source tag 0) or a
+    backup holder's ring (tag d+1) — the failover the reference's
+    write-ahead logs exist for but never implement (SURVEY.md 5.3)."""
+    from dint_tpu import recovery
+
+    n_sub_global = 8 * 256
+    n_loc = ds.n_sub_local(n_sub_global, D)
+    state, _ = _run(n_sub_global=n_sub_global, w=64, blocks=3)
+
+    meta = np.asarray(state.db.meta)
+    val = np.asarray(state.db.val)
+    entries = np.asarray(state.db.log.entries)   # [D, L*CAP, EW]
+    heads = np.asarray(state.db.log.head)        # [D, L]
+    lanes = state.db.log.lanes
+    cap = entries.shape[1] // lanes    # .capacity sees the stacked axis
+
+    def ring_of(dev):
+        return entries[dev].reshape(lanes, cap, -1), heads[dev]
+
+    for dead in (0, 3):
+        snap = td.populate(np.random.default_rng(dead), n_loc, val_words=4,
+                           log_replicas=1)
+        # own log stream (tag 0) and both backup holders' streams (tag d+1)
+        sources = [(dead, 0), ((dead + 1) % D, dead + 1),
+                   ((dead + 2) % D, dead + 1)]
+        for holder, tag in sources:
+            e, h = ring_of(holder)
+            rec = recovery.recover_tatp_dense(snap, e, h,
+                                              key_hi_filter=tag)
+            assert np.array_equal(np.asarray(rec.val), val[dead]), \
+                (dead, holder, tag)
+            got = np.asarray(rec.meta) & ~np.uint32(1)
+            want = meta[dead] & ~np.uint32(1)
+            assert np.array_equal(got, want), (dead, holder, tag)

@@ -78,3 +78,25 @@ def test_hot_contention_rejects_across_devices():
     _, total, _ = _run(n_accounts=16, w=4, blocks=4, seed=2,
                        hot_frac=1.0, hot_prob=1.0)
     assert int(total[dsb.STAT_AB_LOCK]) > 0
+
+
+def test_lost_device_balance_range_recovers_from_any_ring():
+    """A lost device's primary balances rebuild from ANY of the 3 rings
+    carrying its stream (entries log GLOBAL account ids; owner =
+    acct % D separates streams)."""
+    from dint_tpu import recovery
+
+    n_accounts = 2048
+    state, total, _ = _run(n_accounts=n_accounts, w=64, blocks=3)
+    bal = np.asarray(state.bal)                  # [D, m1]
+    entries = np.asarray(state.log.entries)      # [D, L*CAP, EW]
+    heads = np.asarray(state.log.head)           # [D, L]
+    lanes = state.log.lanes
+    cap = entries.shape[1] // lanes
+
+    for dead in (1, 5):
+        for holder in (dead, (dead + 1) % D, (dead + 2) % D):
+            rec = recovery.recover_sb_shard(
+                n_accounts, dead, D,
+                entries[holder].reshape(lanes, cap, -1), heads[holder])
+            assert np.array_equal(rec, bal[dead]), (dead, holder)
