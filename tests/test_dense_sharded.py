@@ -115,3 +115,15 @@ def test_lost_device_recovers_from_any_log_stream():
             got = np.asarray(rec.meta) & ~np.uint32(1)
             want = meta[dead] & ~np.uint32(1)
             assert np.array_equal(got, want), (dead, holder, tag)
+
+
+def test_uneven_partition_rounds_up():
+    """n_sub_global not divisible by D: every device sizes for the ceil
+    and the accounting still closes (psummed across the mesh)."""
+    state, total = _run(n_sub_global=8 * 100 + 3, w=32, blocks=2)
+    assert int(total[td.STAT_ATTEMPTED]) == 2 * 2 * 32 * D
+    outcomes = (int(total[td.STAT_COMMITTED])
+                + int(total[td.STAT_AB_LOCK])
+                + int(total[td.STAT_AB_MISSING])
+                + int(total[td.STAT_AB_VALIDATE]))
+    assert outcomes == int(total[td.STAT_ATTEMPTED])
