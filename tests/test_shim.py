@@ -119,3 +119,62 @@ def test_pump_batches_full_width():
             assert r["n"] == n
             assert (r["type"] == 8).all()
         assert p.server.stats()["pkts_rx"] >= n
+
+
+def test_smallbank_wire_lock_commit_roundtrip(rng):
+    """SmallBank over the reference 55-byte wire format: fused X-lock+read
+    grants with the balance, COMMIT_PRIM installs + releases, re-lock sees
+    the new balance (smallbank/caladan/proto.h:14-37 type codes)."""
+    from dint_tpu.clients.smallbank_client import init_shards
+    from dint_tpu.clients import workloads as wl
+    from dint_tpu.engines import smallbank
+    from dint_tpu.shim import SMALLBANK
+
+    shard = init_shards(64, init_balance=100)[0]
+    with EnginePump(SMALLBANK, smallbank.step, shard, width=128,
+                    flush_us=2000, val_words=2).start() as p:
+        _warm(p)
+        with ShimClient("127.0.0.1", p.port) as c:
+            # kAcquireExclusive (1) on SAVINGS acct 7: grant carries balance
+            r = c.exchange(np.array([1], np.uint8),
+                           np.array([7], np.uint64),
+                           tables=np.array([smallbank.SAVINGS], np.uint8),
+                           timeout_ms=5000)
+            assert r["n"] == 1 and r["type"][0] == 9      # kGrantExclusive
+            bal = int(np.frombuffer(r["val"][0][:4].tobytes(),
+                                    np.uint32)[0])
+            assert bal == 100
+            # kCommitPrim (4) installs bal 250 + releases the row lock
+            nv = np.zeros((1, 40), np.uint8)
+            nv[0, :4] = np.frombuffer(np.uint32(250).tobytes(), np.uint8)
+            nv[0, 4:8] = np.frombuffer(np.uint32(wl.SB_MAGIC).tobytes(),
+                                       np.uint8)
+            r = c.exchange(np.array([4], np.uint8),
+                           np.array([7], np.uint64), vals=nv,
+                           vers=np.array([2], np.uint32),
+                           tables=np.array([smallbank.SAVINGS], np.uint8),
+                           timeout_ms=5000)
+            assert r["n"] == 1 and r["type"][0] == 13     # kCommitPrimAck
+            # while still X-held, a second acquire REJECTS (type 10)
+            r = c.exchange(np.array([1], np.uint8),
+                           np.array([7], np.uint64),
+                           tables=np.array([smallbank.SAVINGS], np.uint8),
+                           timeout_ms=5000)
+            assert r["n"] == 1 and r["type"][0] == 10     # kRejectExclusive
+            # kReleaseExclusive (3): the coordinator's final phase
+            # (lock -> log x3 -> bck x2 -> prim -> RELEASE,
+            #  client_ebpf_shard.cc:389-560)
+            r = c.exchange(np.array([3], np.uint8),
+                           np.array([7], np.uint64),
+                           tables=np.array([smallbank.SAVINGS], np.uint8),
+                           timeout_ms=5000)
+            assert r["n"] == 1 and r["type"][0] == 12     # kReleaseExclusiveAck
+            # re-acquire: grant carries the NEW balance
+            r = c.exchange(np.array([1], np.uint8),
+                           np.array([7], np.uint64),
+                           tables=np.array([smallbank.SAVINGS], np.uint8),
+                           timeout_ms=5000)
+            assert r["n"] == 1 and r["type"][0] == 9
+            bal = int(np.frombuffer(r["val"][0][:4].tobytes(),
+                                    np.uint32)[0])
+            assert bal == 250
