@@ -47,8 +47,10 @@ import time
 ASSUMED_BASELINE = 3.0e6  # committed txn/s, tatp/ebpf single-server estimate
 
 # DINT_BENCH_* env overrides exist for smoke tests / the L6 sweep driver;
-# defaults are the headline configuration.
-N_SUBSCRIBERS = int(os.environ.get("DINT_BENCH_SUBSCRIBERS", 100_000))
+# defaults are the headline configuration: the reference's FULL keyspace,
+# 7M subscribers x 5 tables (tatp/caladan/tatp.h:28), ~6.2 GB of tables
+# in the tight interleaved layout, populated on device.
+N_SUBSCRIBERS = int(os.environ.get("DINT_BENCH_SUBSCRIBERS", 7_000_000))
 WIDTH = int(os.environ.get("DINT_BENCH_WIDTH", 8192))   # txns per cohort
 BLOCK = int(os.environ.get("DINT_BENCH_BLOCK", 16))     # cohorts per dispatch
 VAL_WORDS = 10
@@ -94,8 +96,10 @@ def _child_main():
     from dint_tpu.engines import tatp_dense as td
 
     t0 = _time.time()
-    db = td.populate(np.random.default_rng(0), N_SUBSCRIBERS,
-                     val_words=VAL_WORDS)
+    # on-device populate: at 7M subscribers the val array is ~6.2 GB — host
+    # numpy populate would push it through the tunnel; generate it in HBM
+    db = td.populate_device(jax.random.PRNGKey(0), N_SUBSCRIBERS,
+                            val_words=VAL_WORDS)
     run, init, drain = td.build_pipelined_runner(
         N_SUBSCRIBERS, w=WIDTH, val_words=VAL_WORDS, cohorts_per_block=BLOCK)
     carry = init(db)
@@ -175,6 +179,7 @@ def _child_main():
         "p50_us": round(p["p50"], 1),
         "p99_us": round(p["p99"], 1),
         "p999_us": round(p["p999"], 1),
+        "lat_samples": int(p["n"]),
         "n_subscribers": N_SUBSCRIBERS,
         "width": WIDTH,
         "blocks": blocks,
@@ -216,16 +221,91 @@ def _bench_smallbank():
     Returns extra JSON fields; raises if the pipeline is unavailable."""
     from dint_tpu.clients import bench_smallbank
 
+    # measured on v5e: SmallBank's 3-lane txns amortize per-step overheads
+    # past TATP's w=8192 knee (870k @8192 -> 1.32M @16384) but wider
+    # points pay in abort rate — both sides of the trade are benched and
+    # quoted; the headline is the abort-matched point (bench_smallbank.run)
+    env_w = os.environ.get("DINT_BENCH_SB_WIDTH")
+    widths = (int(env_w),) if env_w else bench_smallbank.WIDTHS
     return bench_smallbank.run(
         window_s=WINDOW_S,
         n_accounts=int(os.environ.get("DINT_BENCH_SB_ACCOUNTS",
                                       bench_smallbank.N_ACCOUNTS)),
-        # measured on v5e: TATP peaks at w=8192 (step scales ~linearly in
-        # w) but SmallBank's 3-lane txns amortize per-step overheads
-        # further out (870k @8192 -> 1.32M @16384 -> 1.37M @32768); 16384
-        # is the knee, and the wider points pay in abort rate (17% @32768)
-        width=int(os.environ.get("DINT_BENCH_SB_WIDTH", 16384)),
+        widths=widths,
         block=BLOCK)
+
+
+ARTIFACT_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "artifacts")
+
+
+def _git_head() -> str:
+    try:
+        c = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                           capture_output=True, text=True, timeout=10,
+                           cwd=os.path.dirname(os.path.abspath(__file__)))
+        return c.stdout.strip() or "unknown"
+    except Exception:
+        return "unknown"
+
+
+def _persist_artifact(out: dict):
+    """Write the measurement to artifacts/BENCH_<commit>_<ts>.json so every
+    hardware number is a committed, timestamped file (round-3 verdict: the
+    1.13M claim lived only in a gitignored working-tree file). The file is
+    committed by the normal work cycle / the driver's end-of-round commit."""
+    out["commit"] = _git_head()
+    out["ts"] = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+    try:
+        os.makedirs(ARTIFACT_DIR, exist_ok=True)
+        path = os.path.join(ARTIFACT_DIR,
+                            f"BENCH_{out['commit']}_{out['ts']}.json")
+        with open(path, "w") as f:
+            json.dump(out, f, indent=1)
+    except OSError as e:
+        print(f"artifact write failed: {e!r}", file=sys.stderr)
+
+
+def _emit_stale(reason: str) -> bool:
+    """All attempts failed (e.g. the tunnel outage that voided round 3's
+    BENCH_r03.json): emit the most recent good committed measurement marked
+    stale, so the driver still records a number + its provenance.
+
+    Ordered by the timestamp segment of BENCH_<commit>_<ts>.json (NOT the
+    whole filename — the commit hash would dominate a plain sort), and
+    only an artifact whose config matches the current headline config is
+    eligible: a smoke-run artifact (DINT_BENCH_* overrides) must never be
+    published as the stale headline number."""
+    try:
+        files = sorted((f for f in os.listdir(ARTIFACT_DIR)
+                        if f.startswith("BENCH_") and f.endswith(".json")),
+                       key=lambda f: f.rsplit("_", 1)[-1])
+    except OSError:
+        return False
+    fallback = None
+    for name in reversed(files):
+        try:
+            with open(os.path.join(ARTIFACT_DIR, name)) as f:
+                out = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if out.get("value", 0) <= 0:
+            continue
+        if (out.get("n_subscribers") == N_SUBSCRIBERS
+                and out.get("width") == WIDTH):
+            out["stale"] = True
+            out["stale_reason"] = reason[:300]
+            print(json.dumps(out))
+            return True
+        if fallback is None:
+            fallback = out
+    if fallback is not None:   # newest good artifact of ANY config —
+        fallback["stale"] = True        # flagged so it cannot pass as a
+        fallback["stale_reason"] = reason[:300]   # current-config number
+        fallback["stale_config_mismatch"] = True
+        print(json.dumps(fallback))
+        return True
+    return False
 
 
 def _diag_json(reason: str, detail: str):
@@ -288,12 +368,14 @@ def main():
                 out["smallbank_error"] = (
                     f"secondary leg lost: {reason}; "
                     f"stderr tail: {stderr.strip()[-200:]}")
+            _persist_artifact(out)
             print(json.dumps(out))
             return
         last = f"{reason}; stderr tail: {stderr.strip()[-300:]}"
         print(last, file=sys.stderr)
 
-    _diag_json("all attempts failed", last)
+    if not _emit_stale(f"all attempts failed: {last}"):
+        _diag_json("all attempts failed", last)
 
 
 if __name__ == "__main__":

@@ -24,10 +24,31 @@ from ..engines import smallbank_dense as sd
 N_ACCOUNTS = 24_000_000
 WIDTH = 8192
 BLOCK = 16
+# both sides of the width/abort trade, quoted side by side: w=8192 commits
+# fewer txn/s at low-single-digit aborts; w=16384 commits more at ~2x the
+# abort rate. The HEADLINE is the abort-matched point (lowest abort rate)
+# because the baseline criterion is throughput at MATCHED abort rate
+# (BASELINE.md north star), not peak throughput at any abort rate.
+WIDTHS = (8192, 16384)
 
 
 def run(window_s: float = 10.0, n_accounts: int = N_ACCOUNTS,
-        width: int = WIDTH, block: int = BLOCK) -> dict:
+        widths=WIDTHS, block: int = BLOCK) -> dict:
+    """Bench every width in ``widths``; headline the abort-matched point
+    and quote all (width, tps, abort_rate) points."""
+    points = [_run_one(window_s, n_accounts, w, block) for w in widths]
+    head = min(points, key=lambda p: p["abort_rate"])
+    return {
+        "smallbank_committed_txns_per_sec": head["committed_tps"],
+        "smallbank_abort_rate": head["abort_rate"],
+        "smallbank_width": head["width"],
+        "smallbank_points": points,
+        "smallbank_balance_conserved": True,
+    }
+
+
+def _run_one(window_s: float, n_accounts: int, width: int,
+             block: int) -> dict:
     db = sd.create(n_accounts)
     base = int(np.asarray(sd.total_balance(db)))
     runner, init, drain = sd.build_pipelined_runner(
@@ -63,7 +84,7 @@ def run(window_s: float = 10.0, n_accounts: int = N_ACCOUNTS,
             f"accounted {accounted} (mod 2^32)")
 
     return {
-        "smallbank_committed_txns_per_sec": round(committed / dt, 1),
-        "smallbank_abort_rate": round(1 - committed / max(attempted, 1), 5),
-        "smallbank_balance_conserved": True,
+        "width": width,
+        "committed_tps": round(committed / dt, 1),
+        "abort_rate": round(1 - committed / max(attempted, 1), 5),
     }

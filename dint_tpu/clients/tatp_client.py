@@ -236,8 +236,9 @@ class Coordinator:
 
         # required-row checks
         missing = np.zeros(w, bool)
-        m = t == wl.TATP_GET_NEW_DEST     # sf must exist (cf optional)
-        missing |= m & (r_rt[:, 0] != Reply.VAL)
+        m = t == wl.TATP_GET_NEW_DEST     # sf AND cf must exist
+        missing |= m & ((r_rt[:, 0] != Reply.VAL)
+                        | (r_rt[:, 1] != Reply.VAL))
         m = t == wl.TATP_UPDATE_SUBSCRIBER
         missing |= m & ((r_rt[:, 0] != Reply.VAL) | (r_rt[:, 1] != Reply.VAL))
         m = t == wl.TATP_UPDATE_LOCATION

@@ -125,7 +125,14 @@ class DenseBank:
 def create(n_accounts: int, init_balance: int = 1000, log_lanes: int = 16,
            log_capacity: int = 1 << 16) -> DenseBank:
     """Populated on device (reference: smallbank/ebpf/shard_user.c:74-77);
-    every account starts at init_balance."""
+    every account starts at init_balance.
+
+    ``log_capacity`` bounds the recovery window: the ring holds
+    lanes*capacity entries and wraps like the reference's fixed rings
+    (log_server/ebpf/ls_kern.c:72-73), and recover_* REFUSES a wrapped
+    ring. The default (1M entries) wraps within ~1 s at full bench
+    throughput — benchmarks trade recoverability for HBM; pass a larger
+    capacity when recovery artifacts are wanted."""
     m1 = 2 * n_accounts + 1
     h = lock_slots_for(m1)
     bal = jnp.full((m1,), np.uint32(init_balance), U32).at[-1].set(0)

@@ -37,26 +37,36 @@ structural facts the reference cannot:
 
 Per-row metadata packs into ONE u32 word (`meta`):
 
-    bits [31:2] = ver   (monotonic: commit/insert/delete all bump it, so
+    bits [31:1] = ver   (monotonic: commit/insert/delete all bump it, so
                          OCC validate is an equality compare with no
                          delete/reinsert ABA window)
-    bit  1      = exists
-    bit  0      = locked (the union of the 3 servers' lock tables)
+    bit  0      = exists
 
-``meta >> 1`` (ver:exists, lock bit dropped) is the value OCC validation
-compares — reads do not observe locks, exactly the reference's verify
-stage (client_ebpf_shard.cc:765-768). One gather serves wave-1 read +
-lock + existence + version; one scatter per step installs commits AND
-releases locks (an install writes ``(ver+1)<<2 | exists<<1 | 0``; an
-abort-release rewrites the wave-1 value with bit0 clear, reconstructed
-from the carried version — the row was X-held in between, so no re-read
-is needed).
+`meta` IS the value OCC validation compares — reads never observe locks,
+exactly the reference's verify stage (client_ebpf_shard.cc:765-768),
+because locks live in a SEPARATE step-stamped arbitration array (`arb`):
+
+    arb[row] = step_granted << K_ARB | (2w-1 - winning_slot)
+
+Every lock in the 3-stage pipeline has a FIXED lifetime — granted in
+wave 1 of step t, released in wave 3 of step t+2 (commit, insert,
+delete, and abort all release then) — so releases need no scatter at
+all: a row is held iff ``(arb >> K_ARB) == step - 1``, and stamps from
+step-2 or older have simply expired (the same expiring-stamp design as
+smallbank_dense's S/X tables). This removes BOTH wave-3 release lanes
+and the wave-1 grant scatter from the meta dependency chain: the table
+chain is install-scatter -> gather (2 random ops) and the lock chain is
+gather -> scatter-max -> gather (3 random ops) on an INDEPENDENT array,
+so XLA overlaps them — measured on v5e, the serialized 5-op meta chain
+was the step's critical path (PERF.md round 3).
 
 Conflict resolution per fused step (replacing ops/segments.sort_batch):
   * commits: X-certified one-writer-per-row -> direct scatter.
-  * lock acquires: first-slot-wins via scatter-min of write-slot index
-    into a per-row winner scratch, then a gather-back compare — the
-    batched equivalent of the reference's CAS loop (shard_kern.c:251-297).
+  * lock acquires: first-slot-wins via scatter-MAX of the packed
+    (step, inverted slot) stamp — the batched equivalent of the
+    reference's CAS loop (shard_kern.c:251-297). Candidates targeting a
+    HELD row (stamp == step-1) are masked out of the scatter, so a
+    stream of rejected attempts cannot re-stamp (livelock) a hot row.
     Arbitration runs in [w, 2] write-slot space (2 lock slots per txn),
     measured 2x cheaper than arbitrating all [w, K] lanes.
   * reads/validates: pure gathers.
@@ -73,10 +83,12 @@ commit of t-2 fused into ONE device program) is inherited from
 engines/tatp_pipeline.py, which remains the semantics reference; its
 gen_cohort (txn mix, NURand, lane layout) is reused verbatim.
 
-Memory: ~22*(n_sub+1) rows; val dominates at N*VW u32 (tiled to 128
-words/row). At the bench's n_sub=1e5 that's ~1.1 GB + a 0.5 GB log —
-single-chip HBM. Reference scale (n_sub=7e6) needs the multi-chip shard
-path, as it does for the reference (3 servers).
+Memory: ~22*(n_sub+1) rows; val dominates at N*VW u32 words in a tight
+interleaved 1-D layout (40 B/row at VW=10 — see DenseDB.val). At the
+reference's full n_sub=7e6 (tatp/caladan/tatp.h:28) that is ~6.2 GB val
++ 0.6 GB meta + the log — single-chip HBM, populated on device
+(populate_device). The multi-chip shard path (parallel/dense_sharded.py)
+multiplies throughput, not feasibility.
 """
 from __future__ import annotations
 
@@ -98,7 +110,11 @@ from .tatp_pipeline import (STAT_ATTEMPTED, STAT_COMMITTED, STAT_AB_LOCK,     # 
 I32 = jnp.int32
 U32 = jnp.uint32
 
-BIG = jnp.int32(1 << 30)
+# arb stamp layout: step << K_ARB | (2w-1 - slot). Supports w <= 2^17 and
+# 2^(32-K_ARB) = 16384 steps between rebases (build_pipelined_runner
+# rebases the stamps when step approaches the limit).
+K_ARB = 18
+REBASE_AT = (1 << (32 - K_ARB)) - 4096
 
 
 def _bases(p1: int) -> np.ndarray:
@@ -113,27 +129,49 @@ def n_rows(n_sub: int) -> int:
 @flax.struct.dataclass
 class DenseDB:
     """All 5 TATP tables + locks + logs in flat dense arrays (row N is the
-    sentinel every NOP/padded lane gathers from; it is never written)."""
-    val: jax.Array      # u32 [N+1, VW]  word0 payload, word1 magic
-    meta: jax.Array     # u32 [N+1]      ver<<2 | exists<<1 | locked
+    sentinel every NOP/padded lane gathers from; it is never written).
+
+    ``val`` is a tight interleaved 1-D word array (row r's words at
+    [r*VW, (r+1)*VW)) — NOT [N+1, VW]: XLA tiles a trailing dim of 10 to
+    128 lanes (512 B/row), which put the reference's 7M-subscriber scale
+    (tatp/caladan/tatp.h:28, 154M rows) at 79 GB. The 1-D layout is the
+    same one the multi-chip backups always used
+    (parallel/dense_sharded.ShardState) and costs 40 B/row: ~6.2 GB at
+    7M subscribers, single-chip HBM."""
+    val: jax.Array      # u32 [(N+1) * VW] interleaved; word0 payload, word1 magic
+    meta: jax.Array     # u32 [N+1]      ver<<1 | exists
+    arb: jax.Array      # u32 [N+1]      step-stamped lock arbitration word
+    step: jax.Array     # u32 scalar, monotonic (starts at 2: stamp 0 is
+                        #   "never held", so step-1 must never be 0)
     log: logring.RepLog   # 3 replica entries packed per slot (log x3)
+    val_words: int = flax.struct.field(pytree_node=False, default=10)
 
     @property
     def n_sub(self):
         return self.meta.shape[0] // 22 - 1
 
+    @property
+    def val2d(self):
+        """[..., N+1, VW] view for tests / recovery / oracles (materializes
+        a tiled copy on device — NOT the hot path)."""
+        return self.val.reshape(self.val.shape[:-1]
+                                + (-1, self.val_words))
+
     # convenience views (tests / recovery / oracles — not the hot path)
     @property
     def ver(self):
-        return self.meta >> 2
+        return self.meta >> 1
 
     @property
     def exists(self):
-        return (self.meta & 2) != 0
+        return (self.meta & 1) != 0
 
     @property
     def locked(self):
-        return (self.meta & 1) != 0
+        """Rows X-held RIGHT NOW: stamped by the previous step (stamps
+        from step-2 and older have expired). Works on stacked
+        [..., N+1] state too."""
+        return (self.arb >> K_ARB) == (self.step[..., None] - 1)
 
 
 def create(n_sub: int, val_words: int = 10, log_lanes: int = 16,
@@ -141,13 +179,24 @@ def create(n_sub: int, val_words: int = 10, log_lanes: int = 16,
            log_replicas: int = N_SHARDS) -> DenseDB:
     """``log_replicas``: the single-chip engine packs the log x3 locally;
     the multi-chip path (parallel/dense_sharded.py) passes 1 because the
-    3 copies live on 3 devices there."""
+    3 copies live on 3 devices there.
+
+    ``log_capacity`` bounds the recovery window: the ring wraps like the
+    reference's (ls_kern.c:72-73) and recover_* refuses a wrapped ring —
+    at bench throughput the 1M-entry default wraps within ~1 s; pass a
+    larger capacity when recovery artifacts are wanted."""
     n1 = n_rows(n_sub) + 1
+    # flat word indices (row * VW + j) are computed in i32 on device
+    assert n1 * val_words < (1 << 31), \
+        f"n_sub={n_sub} x val_words={val_words} overflows i32 row*VW indices"
     return DenseDB(
-        val=jnp.zeros((n1, val_words), U32),
+        val=jnp.zeros((n1 * val_words,), U32),
         meta=jnp.zeros((n1,), U32),
+        arb=jnp.zeros((n1,), U32),
+        step=jnp.asarray(2, U32),
         log=logring.create_rep(log_lanes, log_capacity, val_words,
                                replicas=log_replicas),
+        val_words=val_words,
     )
 
 
@@ -169,7 +218,7 @@ def populate(rng: np.random.Generator, n_sub: int, val_words: int = 10,
     def put(rows, payload):
         val[rows, 0] = payload.astype(np.uint32)
         val[rows, 1] = MAGIC
-        meta[rows] = (1 << 2) | (1 << 1)      # ver 1, exists, unlocked
+        meta[rows] = (1 << 1) | 1             # ver 1, exists
 
     s_ids = np.arange(1, p1)
     put(base[tatp.SUBSCRIBER] + s_ids, s_ids)
@@ -193,7 +242,58 @@ def populate(rng: np.random.Generator, n_sub: int, val_words: int = 10,
     cf_keys = np.unique(np.concatenate(cf_keys)).astype(np.int64)
     put(base[tatp.CALL_FORWARDING] + cf_keys, cf_keys)
 
-    return db.replace(val=jnp.asarray(val), meta=jnp.asarray(meta))
+    return db.replace(val=jnp.asarray(val.reshape(-1)),
+                      meta=jnp.asarray(meta))
+
+
+def populate_device(key, n_sub: int, val_words: int = 10, **kw) -> DenseDB:
+    """On-device populate for reference-scale tables: same population RULES
+    as `populate` (all subscribers present; ai/sf types present w.p. 0.625
+    with >=1 each; CF on 25% of present sf rows per start_time —
+    tatp/caladan/client_ebpf_shard.cc:96-341) drawn from the device RNG, so
+    the 6+ GB val array at n_sub=7e6 is generated in HBM instead of being
+    built in host numpy and pushed through the tunnel. Not bit-identical to
+    the numpy path (different RNG stream); distribution-identical, which is
+    what the abort-taxonomy expectations depend on."""
+    p1 = n_sub + 1
+    db = create(n_sub, val_words=val_words, **kw)
+    n1 = n_rows(n_sub) + 1
+    base = jnp.asarray(_bases(p1))
+
+    @jax.jit
+    def build(key):
+        k_ai, k_sf, k_cf = jax.random.split(key, 3)
+        sub_e = jnp.arange(p1, dtype=I32) >= 1                  # [p1]
+
+        def present(k):
+            pr = jax.random.bernoulli(k, 0.625, (p1, 4))
+            pr = pr.at[:, 0].set(pr[:, 0] | ~pr.any(axis=1))    # >=1 each
+            return pr & sub_e[:, None]
+
+        ai_p = present(k_ai)
+        sf_p = present(k_sf)
+        # cf rows: [p1, 4 sf_types, 3 start_times]; flat index IS cf_key =
+        # s*12 + (sf_type-1)*3 + start_time/8 (tatp.cf_key)
+        cf_p = sf_p[:, :, None] & jax.random.bernoulli(k_cf, 0.25,
+                                                       (p1, 4, 3))
+        exists = jnp.concatenate([
+            sub_e, sub_e, ai_p.reshape(-1), sf_p.reshape(-1),
+            cf_p.reshape(-1), jnp.zeros((1,), bool)])           # [n1]
+        meta = jnp.where(exists, U32((1 << 1) | 1), U32(0))
+
+        # payload = index within the row's table region (populate's `put`)
+        rows = jnp.arange(n1, dtype=I32)
+        region = jnp.searchsorted(base, rows, side="right") - 1
+        payload = (rows - base[jnp.clip(region, 0, 4)]).astype(U32)
+        val = jnp.zeros((n1 * val_words,), U32)
+        idx = jnp.where(exists, rows, n1) * val_words   # absent -> dropped
+        val = val.at[idx].set(payload, mode="drop", unique_indices=True)
+        val = val.at[idx + 1].set(U32(MAGIC), mode="drop",
+                                  unique_indices=True)
+        return val, meta
+
+    val, meta = build(key)
+    return db.replace(val=val, meta=meta)
 
 
 # ---------------------------------------------------------------- pipeline
@@ -206,7 +306,7 @@ class DenseCtx:
     have attempted == 0 and all-False masks."""
     rows: jax.Array       # i32 [w, K] flat row ids (sentinel for NOP lanes)
     is_read: jax.Array    # bool [w, K] OCC_READ lanes
-    vv1: jax.Array        # u32 [w, K] meta>>1 (ver:exists) at wave 1
+    vv1: jax.Array        # u32 [w, K] meta (ver<<1|exists) at wave 1
     alive: jax.Array      # bool [w]
     ro_commit: jax.Array  # bool [w]
     granted: jax.Array    # bool [w, 2]
@@ -255,7 +355,7 @@ class Installs:
     ids; wmask marks real writes (releases are lock-only and stay local)."""
     wmask: jax.Array     # bool [2w]
     rows: jax.Array      # i32 [2w]
-    meta: jax.Array      # u32 [2w]  new ver<<2|exists<<1 (lock bit clear)
+    meta: jax.Array      # u32 [2w]  new ver<<1|exists
     val: jax.Array       # u32 [2w, VW]
     tbl: jax.Array       # i32 [2w]  (for the log)
     key: jax.Array       # u32 [2w]
@@ -279,29 +379,24 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
     oob = n1          # scatter index for masked lanes under mode="drop"
     base = jnp.asarray(_bases(p1))
     kg, kv3 = jax.random.split(key)
+    t = db.step
 
-    # ---- wave 3 of c2: install + unlock + log -----------------------------
-    # one meta scatter covers every granted slot: installs write the bumped
-    # version with the lock bit clear (COMMIT/INSERT/DELETE_PRIM release the
-    # row lock, shard_kern.c:338-476); aborted-but-granted slots rewrite
-    # their wave-1 value with bit0 clear (the row was X-held since wave 1,
-    # so ws_vv is still current — no re-read). Uniqueness: one X-holder per
-    # row, and a txn's two slots target different tables.
+    # ---- wave 3 of c2: install + log --------------------------------------
+    # the meta scatter covers ONLY real writes: lock releases are implicit —
+    # c2's stamps (from step t-2) expire this step, which is exactly when
+    # COMMIT/INSERT/DELETE_PRIM and ABORT release the row lock in the
+    # reference (shard_kern.c:338-476). Uniqueness: one X-holder per row,
+    # and a txn's two slots target different tables.
     do_write = c2.ws_active & c2.alive[:, None]                 # [w, 2]
     wmask = do_write.reshape(-1)
-    release = c2.granted.reshape(-1) & ~wmask
-    touch = wmask | release
-    trows = jnp.where(touch, c2.ws_rows.reshape(-1), oob)       # [2w]
     wkind = c2.ws_kind.reshape(-1)
     newex = (wkind != 2) & wmask
-    vv = c2.ws_vv.reshape(-1)
-    meta_new = jnp.where(
-        wmask, (((vv >> 1) + 1) << 2) | (newex.astype(U32) << 1),
-        vv << 1)
-    meta = db.meta.at[trows].set(meta_new, mode="drop",
+    vv = c2.ws_vv.reshape(-1)       # wave-1 meta (ver<<1|exists): the row
+    #                                 was X-held since, so still current
+    meta_new = (((vv >> 1) + 1) << 1) | newex.astype(U32)
+    wrows = jnp.where(wmask, c2.ws_rows.reshape(-1), oob)       # [2w]
+    meta = db.meta.at[wrows].set(meta_new, mode="drop",
                                  unique_indices=True)
-
-    wrows = jnp.where(wmask, c2.ws_rows.reshape(-1), oob)
     payload = jax.random.randint(kv3, (w, 2), 0, 1 << 16, dtype=I32)
     newval = jnp.zeros((w, 2, val_words), U32)
     newval = newval.at[:, :, 0].set(payload.astype(U32))
@@ -309,7 +404,13 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
         jnp.where(do_write & (c2.ws_kind != 2), U32(MAGIC), U32(0)))
     newval = newval.reshape(-1, val_words)
     newval = jnp.where((wkind == 2)[:, None], U32(0), newval)   # delete zeroes
-    val = db.val.at[wrows].set(newval, mode="drop", unique_indices=True)
+    # interleaved-1-D install: row r's words live at [r*VW, (r+1)*VW); the
+    # masked-lane oob row lands at n1*VW >= len and drops (same discipline
+    # as parallel/dense_sharded._apply_backup)
+    wflat = (wrows[:, None] * val_words
+             + jnp.arange(val_words, dtype=I32)).reshape(-1)
+    val = db.val.at[wflat].set(newval.reshape(-1), mode="drop",
+                               unique_indices=True)
 
     newver = (vv >> 1) + 1
     flags_del = (wkind == 2).astype(I32)
@@ -320,7 +421,7 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
                               log_key, newver, newval)
 
     # ---- wave 2 of c1: validate read-set version compare ------------------
-    vvB = meta[c1.rows] >> 1                                    # [w, K]
+    vvB = meta[c1.rows]                                         # [w, K]
     bad = c1.is_read & (vvB != c1.vv1)
     changed = bad.any(axis=1)
     c1 = c1.replace(alive=c1.alive & ~changed,
@@ -346,28 +447,31 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
     is_read = ops == Op.OCC_READ
 
     rmeta = meta[rows]                                          # [w, K]
-    vv1 = rmeta >> 1
-    rex = (rmeta & 2) != 0
-    rmagic = val[rows, 1]
+    vv1 = rmeta                     # ver<<1|exists — locks live elsewhere
+    rex = (rmeta & 1) != 0
+    rmagic = val[rows * val_words + 1]
     magic_bad = jnp.sum(is_read & rex & (rmagic != MAGIC), dtype=I32)
 
     # lock arbitration in [w, 2] write-slot space: first slot wins per row
     # (batched CAS, tatp/ebpf/shard_kern.c:251-297); losers and held rows
-    # REJECT. ws_lane points at this txn's lock lanes, so lock state comes
-    # from the wave-1 gather — no extra fetch.
+    # REJECT. The whole chain — stamp gather, masked scatter-max, winner
+    # gather-back — runs on the arb array, INDEPENDENT of the meta/val
+    # install chain. held = stamped by the previous step; c2's stamps
+    # (t-2) expired this step, matching the wave-3 release timing above.
+    # Candidates for held rows are masked OUT of the scatter so rejected
+    # attempts cannot keep a hot row stamped (no livelock).
     ws_rows = jnp.where(ws_active, base[ws_tbl] + ws_key, sent)  # [w, 2]
-    ws_meta = jnp.take_along_axis(rmeta, ws_lane, axis=1)
-    ws_vv = jnp.take_along_axis(vv1, ws_lane, axis=1)
-    held = (ws_meta & 1) != 0
+    ws_vv = jnp.take_along_axis(rmeta, ws_lane, axis=1)
     flat_ws = ws_rows.reshape(-1)
-    slot_idx = jnp.arange(2 * w, dtype=I32)
-    arb_rows = jnp.where(ws_active.reshape(-1), flat_ws, oob)
-    winner = jnp.full((n1,), BIG, I32).at[arb_rows].min(slot_idx,
+    active = ws_active.reshape(-1)
+    arb_old = db.arb[flat_ws]       # [2w]; sentinel row is never stamped
+    held = (arb_old >> K_ARB) == (t - 1)
+    inv_slot = U32(2 * w - 1) - jnp.arange(2 * w, dtype=U32)
+    packed = (t << K_ARB) | inv_slot
+    cand = active & ~held
+    arb = db.arb.at[jnp.where(cand, flat_ws, oob)].max(packed,
                                                        mode="drop")
-    grant = (ws_active.reshape(-1) & ~held.reshape(-1)
-             & (winner[flat_ws] == slot_idx)).reshape(w, 2)
-    meta = meta.at[jnp.where(grant.reshape(-1), flat_ws, oob)].set(
-        (ws_vv.reshape(-1) << 1) | 1, mode="drop", unique_indices=True)
+    grant = (cand & (arb[flat_ws] == packed)).reshape(w, 2)
 
     # reply types: reads from the gather; write-slot GRANT/REJECT direct
     rt = jnp.where(is_read & used,
@@ -393,7 +497,7 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
         ab_validate=jnp.asarray(0, I32),
         magic_bad=magic_bad)
 
-    db = db.replace(val=val, meta=meta, log=logs)
+    db = db.replace(val=val, meta=meta, arb=arb, step=t + 1, log=logs)
     if emit_installs:
         inst = Installs(
             wmask=wmask, rows=c2.ws_rows.reshape(-1),
@@ -404,10 +508,27 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
     return db, new_ctx, c1, _stats_of(c2)
 
 
+def rebase_stamps(db: DenseDB) -> DenseDB:
+    """Rebase arb stamps so the step field never overflows its u32 budget:
+    live stamps (step-1 -> 2, step-2 -> 1) are kept, everything older is
+    zeroed, and the step counter restarts at 3. One full elementwise pass,
+    run once per ~16k steps."""
+    t = db.step
+    ts = db.arb >> K_ARB
+    keep = ts + 2 >= t
+    new_ts = jnp.where(keep, ts - (t - 3), 0)
+    arb = jnp.where(keep, (new_ts << K_ARB)
+                    | (db.arb & U32((1 << K_ARB) - 1)), U32(0))
+    # t*0+3 (not a fresh constant) so the step keeps its varying-axis type
+    # under shard_map's lax.cond (dense_sharded.block_local)
+    return db.replace(arb=arb, step=t * U32(0) + U32(3))
+
+
 def build_pipelined_runner(n_sub: int, w: int = 8192, val_words: int = 10,
                            cohorts_per_block: int = 8, mix=None):
     """jit(scan(pipe_step)) over carry (db, c1, c2); same contract as
     tatp_pipeline.build_pipelined_runner: returns (run, init, drain)."""
+    assert 2 * w <= (1 << K_ARB), f"w={w} exceeds the arb slot field"
     kw = dict(w=w, n_sub=n_sub, val_words=val_words)
 
     def scan_fn(carry, key):
@@ -416,8 +537,11 @@ def build_pipelined_runner(n_sub: int, w: int = 8192, val_words: int = 10,
         return (db, new_ctx, c1), stats
 
     def block(carry, key):
+        db, c1, c2 = carry
+        db = jax.lax.cond(db.step >= U32(REBASE_AT), rebase_stamps,
+                          lambda d: d, db)
         keys = jax.random.split(key, cohorts_per_block)
-        return jax.lax.scan(scan_fn, carry, keys)
+        return jax.lax.scan(scan_fn, (db, c1, c2), keys)
 
     def init(db):
         return (db, empty_ctx(w), empty_ctx(w))

@@ -394,8 +394,13 @@ def classify_wave1(ttype, rt, ops, ws_active, ws_lane, ws_rt=None):
     lock_rejected = (ws_active & rejected).any(axis=1)
 
     missing = jnp.zeros(t.shape, bool)
+    # GET_NEW_DEST succeeds only when the SPECIAL_FACILITY row exists AND
+    # the CALL_FORWARDING read hits (client_ebpf_shard.cc:492,549-563 —
+    # kNotExist on either ends the txn unsuccessfully; the reference's
+    # additional is_active/end_time predicates are over synthetic payload
+    # fields this schema does not model)
     m = t == wl.TATP_GET_NEW_DEST
-    missing |= m & (rt[:, 0] != Reply.VAL)
+    missing |= m & ((rt[:, 0] != Reply.VAL) | (rt[:, 1] != Reply.VAL))
     m = (t == wl.TATP_UPDATE_SUBSCRIBER) | (t == wl.TATP_UPDATE_LOCATION)
     missing |= m & ((rt[:, 0] != Reply.VAL) | (rt[:, 1] != Reply.VAL))
     m = t == wl.TATP_INSERT_CF

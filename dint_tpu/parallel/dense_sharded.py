@@ -85,8 +85,12 @@ def create_sharded(mesh: Mesh, n_shards: int, n_sub_global: int,
            for d in range(n_shards)]
     db = jax.tree.map(lambda *xs: jnp.stack(xs), *dbs)
     # backups start as copies of the predecessors' populated tables
-    val1d = jnp.stack([d_.val[:-1].reshape(-1) for d_ in dbs])  # [D, n1-1*VW]
-    meta1 = jnp.stack([d_.meta[:-1] >> 1 for d_ in dbs])        # [D, n1-1]
+    # (db.val is already the tight interleaved 1-D layout; drop the
+    # sentinel row's words)
+    val1d = jnp.stack([d_.val[:-val_words] for d_ in dbs])  # [D, (n1-1)*VW]
+    # primary meta is already ver<<1|exists (locks live in db.arb), the
+    # exact backup format
+    meta1 = jnp.stack([d_.meta[:-1] for d_ in dbs])             # [D, n1-1]
 
     def pred(x, off):
         return jnp.roll(x, off, axis=0)     # device d gets device d-off's copy
@@ -114,7 +118,7 @@ def _apply_backup(state: ShardState, inst: td.Installs, slot: int,
     base = slot * n1
     oob = N_BCK * n1
     rows = jnp.where(inst.wmask, base + inst.rows, oob)
-    meta = state.bck_meta.at[rows].set(inst.meta >> 1, mode="drop",
+    meta = state.bck_meta.at[rows].set(inst.meta, mode="drop",
                                        unique_indices=True)
     # masked lanes ride the oob row: oob*val_words is already past the end
     flat = (rows[:, None] * val_words
@@ -142,6 +146,7 @@ def build_sharded_pipelined_runner(mesh: Mesh, n_shards: int,
       init(state)     -> carry with two bootstrap cohorts per device
       drain(carry)    -> (state, stats [2, N_STATS]) flushing pipelines
     """
+    assert 2 * w <= (1 << td.K_ARB), f"w={w} exceeds the arb slot field"
     n_loc = n_sub_local(n_sub_global, n_shards)
     n1 = td.n_rows(n_loc) + 1
     kw = dict(w=w, n_sub=n_loc, val_words=val_words)
@@ -183,9 +188,12 @@ def build_sharded_pipelined_runner(mesh: Mesh, n_shards: int,
         return jax.tree.map(lambda x: x[None], tree)
 
     def block_local(state_blk, c1_blk, c2_blk, key):
+        state0 = sq(state_blk)
+        db = jax.lax.cond(state0.db.step >= jnp.uint32(td.REBASE_AT),
+                          td.rebase_stamps, lambda d: d, state0.db)
         keys = jax.random.split(key, cohorts_per_block)
         carry, stats = jax.lax.scan(
-            scan_fn, (sq(state_blk), sq(c1_blk), sq(c2_blk)), keys)
+            scan_fn, (state0.replace(db=db), sq(c1_blk), sq(c2_blk)), keys)
         state, c1, c2 = carry
         return unsq(state), unsq(c1), unsq(c2), stats
 

@@ -38,9 +38,10 @@ different devices.
 Static-shape routing: per-destination capacity is 2x the uniform share
 (`cap = 2 * ceil(w*L/D)`); lanes that overflow a destination bucket are
 counted as lock rejects (the reference client's retry under overload —
-here a no-wait reject, bounded by the slack). Round-robin partitioning
-keeps destinations near-uniform even under the 90%/4% hot skew, so
-overflow is zero at configured widths (asserted in tests).
+here a no-wait reject, bounded by the slack) AND separately in the
+psummed STAT_OVERFLOW counter, so overflow is observable — tests assert
+it is zero at configured widths (round-robin partitioning keeps
+destinations near-uniform even under the 90%/4% hot skew).
 
 Balance conservation holds GLOBALLY: psummed STAT_BAL_DELTA must equal
 the delta of the all-device balance sum — checked in tests; a
@@ -71,6 +72,10 @@ U32 = jnp.uint32
 BIG = jnp.int32(1 << 30)
 N_BCK = 2
 AXIS = SHARD_AXIS
+
+# sharded stats append a routing-overflow counter to the shared layout
+STAT_OVERFLOW = N_STATS
+N_STATS = N_STATS + 1
 
 
 @flax.struct.dataclass
@@ -158,6 +163,7 @@ class SBCtx:
     ab_logic: jax.Array
     magic_bad: jax.Array
     bal_delta: jax.Array
+    overflow: jax.Array  # lanes dropped by destination-bucket overflow
 
 
 def _empty_sb_ctx(w: int) -> SBCtx:
@@ -168,12 +174,13 @@ def _empty_sb_ctx(w: int) -> SBCtx:
                  do_write=z((w, L), bool), nw=z((w, L), np.int32),
                  attempted=z((), np.int32), committed=z((), np.int32),
                  ab_lock=z((), np.int32), ab_logic=z((), np.int32),
-                 magic_bad=z((), np.int32), bal_delta=z((), np.int32))
+                 magic_bad=z((), np.int32), bal_delta=z((), np.int32),
+                 overflow=z((), np.int32))
 
 
 def _stats_of(c: SBCtx):
     return jnp.stack([c.attempted, c.committed, c.ab_lock, c.ab_logic,
-                      c.magic_bad, c.bal_delta])
+                      c.magic_bad, c.bal_delta, c.overflow])
 
 
 def build_sharded_sb_runner(mesh: Mesh, n_shards: int, n_accounts: int,
@@ -271,7 +278,8 @@ def build_sharded_sb_runner(mesh: Mesh, n_shards: int, n_accounts: int,
             ab_lock=(lock_rejected & (l_op[:, 0] != 0)).sum(dtype=I32),
             ab_logic=logic_abort.sum(dtype=I32),
             magic_bad=jnp.asarray(0, I32),
-            bal_delta=bal_delta)
+            bal_delta=bal_delta,
+            overflow=(active & ~valid).sum(dtype=I32))
 
         # ---- wave 2 of c1: route installs to owners -------------------
         wmask = c1.do_write.reshape(-1)
@@ -292,17 +300,21 @@ def build_sharded_sb_runner(mesh: Mesh, n_shards: int, n_accounts: int,
         bal_new = state.bal.at[irows].set(i_bal.astype(U32), mode="drop",
                                           unique_indices=True)
 
-        def mk_entry(mask, row, balv, tblv, accv, ring, bck, slot):
+        def mk_entry(mask, row, balv, tblv, accv, ring, bck, slot, src_dev):
+            # forwarded entries tag key_hi = SOURCE device + 1 (own entries
+            # log 0, below) — same separable-stream convention as the TATP
+            # path (parallel/dense_sharded._apply_backup), so recovery can
+            # verify a ring's streams against acct % n_shards geometry
             rr = jnp.where(mask, slot * m1 + row, N_BCK * m1)
             bck = bck.at[rr].set(balv.astype(U32), mode="drop",
                                  unique_indices=True)
             newval = jnp.zeros((mask.shape[0], VW), U32)
             newval = newval.at[:, 0].set(balv.astype(U32))
             stepv = jnp.broadcast_to(t, mask.shape)
+            src = jnp.broadcast_to(src_dev.astype(U32) + U32(1), mask.shape)
             ring = logring.append_rep(ring, mask, tblv,
                                       jnp.zeros_like(balv),
-                                      jnp.zeros_like(balv, U32),
-                                      accv.astype(U32), stepv, newval)
+                                      src, accv.astype(U32), stepv, newval)
             return ring, bck
 
         # owner logs its installs (CommitLog at the primary)
@@ -320,7 +332,8 @@ def build_sharded_sb_runner(mesh: Mesh, n_shards: int, n_accounts: int,
             pp = functools.partial(jax.lax.ppermute, axis_name=AXIS,
                                    perm=perm)
             log, bck = mk_entry(pp(i_mask), pp(i_row), pp(i_bal),
-                                pp(i_tbl), pp(i_acc), log, bck, off - 1)
+                                pp(i_tbl), pp(i_acc), log, bck, off - 1,
+                                (dev - off) % d)
 
         state = state.replace(bal=bal_new, bck_bal=bck, x_step=x_step,
                               s_step=s_step, step=t + 1, log=log)

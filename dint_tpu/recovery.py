@@ -24,7 +24,7 @@ def _flat_entries(entries: np.ndarray, heads: np.ndarray,
     """Live entries of a multi-lane ring, as flat arrays.
 
     entries [L, CAP, HDR+VW] u32, heads [L] u32 (monotonic; ring wraps) ->
-    (flags, key_lo, ver, val [n, VW]) of every written slot.
+    (flags, key_hi, key_lo, ver, val [n, VW]) of every written slot.
 
     ``key_hi_filter``: keep only entries whose key_hi word matches — the
     sharded TATP path tags each entry's SOURCE device there (own entries
@@ -45,7 +45,7 @@ def _flat_entries(entries: np.ndarray, heads: np.ndarray,
     e = entries[lane_of, slot_of]
     if key_hi_filter is not None:
         e = e[e[:, 1] == np.uint32(key_hi_filter)]
-    return e[:, 0], e[:, 2], e[:, 3], e[:, HDR_WORDS:]
+    return e[:, 0], e[:, 1], e[:, 2], e[:, 3], e[:, HDR_WORDS:]
 
 
 def latest_per_row(rows: np.ndarray, vers: np.ndarray):
@@ -79,9 +79,9 @@ def recover_tatp_dense(db0, log_entries, log_heads,
     from .engines import tatp_dense as td
 
     n_sub = int(db0.n_sub)
-    flags, key_lo, vers, vals = _flat_entries(np.asarray(log_entries),
-                                              np.asarray(log_heads),
-                                              key_hi_filter)
+    flags, _, key_lo, vers, vals = _flat_entries(np.asarray(log_entries),
+                                                 np.asarray(log_heads),
+                                                 key_hi_filter)
     is_del = (flags & 0xFF).astype(bool)
     table = (flags >> 8).astype(np.int64)
     p1 = n_sub + 1
@@ -95,34 +95,49 @@ def recover_tatp_dense(db0, log_entries, log_heads,
 
     urows, idx = latest_per_row(rows, vers)
 
-    val = np.array(db0.val)
+    vw = db0.val_words
+    val = np.array(db0.val).reshape(-1, vw)
     meta = np.array(db0.meta)
-    vw = val.shape[1]
     val[urows] = vals[idx][:, :vw]
-    # rebuilt meta: logged version + liveness; lock bits are volatile (a
-    # recovering replica restarts with a free lock table, like the
+    # rebuilt meta: logged version + liveness (meta carries no lock state;
+    # the recovering replica's arb stamp table starts free, like the
     # reference's fresh server)
-    meta = meta & ~np.uint32(1)
-    meta[urows] = ((vers[idx].astype(np.uint32) << 2)
-                   | ((~is_del[idx]).astype(np.uint32) << 1))
-    return db0.replace(val=jnp.asarray(val), meta=jnp.asarray(meta))
+    meta[urows] = ((vers[idx].astype(np.uint32) << 1)
+                   | (~is_del[idx]).astype(np.uint32))
+    return db0.replace(val=jnp.asarray(val.reshape(-1)),
+                       meta=jnp.asarray(meta))
 
 
 def recover_sb_shard(n_accounts: int, dead: int, n_shards: int,
-                     log_entries, log_heads, init_balance: int = 1000):
+                     log_entries, log_heads, init_balance: int = 1000,
+                     ring_owner: int | None = None):
     """Rebuild a lost device's PRIMARY balance range for the sharded
     SmallBank path (parallel/dense_sharded_sb.py) from ANY of the 3 log
     rings carrying its stream — its own or a backup holder's (each ring
     holds its device's own installs + the two forwarded streams; entries
     carry GLOBAL account ids, so device `dead`'s stream is
     owner == acct % n_shards). Returns the [m1_loc] balance array
-    (u32, sentinel last) equal to the lost primary's."""
+    (u32, sentinel last) equal to the lost primary's.
+
+    ``ring_owner``: the device whose physical ring this is; when given,
+    every entry's key_hi source tag (0 = the ring owner's own install,
+    src+1 = forwarded from src) is checked against acct % n_shards — a
+    ring written under a different n_shards geometry fails loudly instead
+    of silently mis-assigning accounts."""
     from .parallel.dense_sharded_sb import m1_local, n_acct_local
 
-    flags, key_lo, vers, vals = _flat_entries(np.asarray(log_entries),
-                                              np.asarray(log_heads))
+    flags, key_hi, key_lo, vers, vals = _flat_entries(
+        np.asarray(log_entries), np.asarray(log_heads))
     table = (flags >> 8).astype(np.int64)
     acct = key_lo.astype(np.int64)
+    if ring_owner is not None:
+        src = np.where(key_hi == 0, ring_owner,
+                       key_hi.astype(np.int64) - 1)
+        if not ((acct % n_shards) == src).all():
+            raise ValueError(
+                "log stream mismatch: entry source tags disagree with "
+                "acct % n_shards — the ring was written under a different "
+                "shard geometry")
     mine = (acct % n_shards) == dead
     table, acct, vers, vals = (table[mine], acct[mine], vers[mine],
                                vals[mine])
@@ -147,8 +162,8 @@ def recover_smallbank_dense(db0, log_entries, log_heads):
     import jax.numpy as jnp
 
     n_accounts = int(db0.n_accounts)
-    flags, key_lo, vers, vals = _flat_entries(np.asarray(log_entries),
-                                              np.asarray(log_heads))
+    flags, _, key_lo, vers, vals = _flat_entries(np.asarray(log_entries),
+                                                 np.asarray(log_heads))
     table = (flags >> 8).astype(np.int64)
     if not ((table < 2) & (key_lo.astype(np.int64) < n_accounts)).all():
         raise ValueError("log key out of its table's range: the log "

@@ -136,11 +136,13 @@ def pipeline_open(make_runner, n_stats, *, rate, window_s, w, cpb, depth,
 
 
 def _tatp_runner(n_sub, w, cpb, seed=0):
-    import jax  # noqa: F401
+    import jax
 
     from dint_tpu.engines import tatp_dense as td
 
-    db = td.populate(np.random.default_rng(seed), n_sub, val_words=10)
+    # on-device populate: the full sweep runs at the reference's 7M
+    # subscribers (~6.2 GB) — generated in HBM, not pushed via the host
+    db = td.populate_device(jax.random.PRNGKey(seed), n_sub, val_words=10)
     run, init, drain = td.build_pipelined_runner(n_sub, w=w, val_words=10,
                                                  cohorts_per_block=cpb)
     return run, init(db), drain
@@ -328,6 +330,20 @@ def sweep_micro(window_s, quick, results, want=lambda name: True):
         run_point(results, "store_wire",
                   lambda: _store_wire_bench(window_s, quick))
 
+    if want("tatp_wire"):
+        run_point(results, "tatp_wire",
+                  lambda: _tatp_wire_bench(window_s, quick))
+
+    # colocate analogue (exp/run_tatp_colocate.sh:27: servers share 8
+    # cores): pin THIS process — pump RX thread, batch parse, reply
+    # serialization, dispatch loop — to N cores and re-measure the wire
+    # path; host_ucores scaling vs pkt/s is the reported curve
+    for n in (1, 2, 4):
+        name = f"tatp_colocate_c{n}"
+        if want(name):
+            run_point(results, name,
+                      lambda n=n: _colocate_bench(n, window_s, quick))
+
     for tag in ("wb_bloom", "wb_nobloom", "wt"):
         name = f"store_cached_{tag}"
         if want(name):
@@ -470,18 +486,176 @@ def _store_wire_bench(window_s, quick):
                "transport": "udp_loopback_shim"}).to_dict()
 
 
+def _tatp_wire_bench(window_s, quick):
+    """TATP served OVER THE WIRE: the flagship workload's full
+    request->batch->certify->reply path through the C++ pump — the
+    reference's inherently-networked serving mode (tatp/udp/
+    server_shard.cc, wire codes tatp/ebpf/utils.h:38-73). Loopback
+    clients drive the reference's read-dominant shape (80% kRead across
+    the 5 tables) plus a live kAcquireLock/kAbort slice (each wave aborts
+    the previous wave's grants, so lock occupancy is steady-state);
+    reports pkt/s like the reference's server pps counter."""
+    import threading
+
+    from dint_tpu.clients import tatp_client as tc
+    from dint_tpu.engines import tatp
+    from dint_tpu.shim import TATP, EnginePump, ShimClient
+    from dint_tpu.stats import LatencyReservoir, MetricBlock
+
+    n_sub = 2_000 if quick else 100_000
+    width = 512 if quick else 4_096
+    n_clients = 2
+    wave = width // n_clients
+    n_lock = wave // 10
+
+    shard = tc.populate_shards(np.random.default_rng(0), n_sub,
+                               val_words=10)[0][0]
+
+    with EnginePump(TATP, tatp.step, shard, width=width,
+                    flush_us=500).start() as pump:
+        with ShimClient("127.0.0.1", pump.port) as c:   # warm past compile
+            for attempt in range(8):
+                if c.exchange(np.zeros(1, np.uint8),
+                              np.array([1], np.uint64),
+                              timeout_ms=20_000)["n"] == 1:
+                    break
+            else:
+                raise RuntimeError(
+                    "tatp_wire pump answered no warmup exchange in 8 "
+                    "attempts — refusing to publish a compile-polluted "
+                    "measurement")
+
+        stop_at = time.time() + window_s
+        sent = np.zeros(n_clients, np.int64)
+        answered = np.zeros(n_clients, np.int64)
+        grants = np.zeros(n_clients, np.int64)
+        lats = [LatencyReservoir(seed=i) for i in range(n_clients)]
+
+        def worker(i):
+            rng = np.random.default_rng(i)
+            # lock keys partition by client so an abort always targets a
+            # row this client locked (disjoint subscriber halves)
+            lo = 1 + i * (n_sub // n_clients)
+            hi = lo + n_sub // n_clients
+            prev_locks = np.zeros(0, np.uint64)
+            with ShimClient("127.0.0.1", pump.port) as c:
+                while time.time() < stop_at:
+                    n_ab = len(prev_locks)
+                    n_rd = wave - n_lock - n_ab
+                    rd_tbl = rng.integers(0, 5, n_rd).astype(np.uint8)
+                    rd_key = rng.integers(1, n_sub + 1, n_rd)
+                    rd_key = np.where(
+                        rd_tbl >= tatp.ACCESS_INFO, rd_key * 4
+                        + rng.integers(0, 4, n_rd), rd_key)
+                    rd_key = np.where(
+                        rd_tbl == tatp.CALL_FORWARDING,
+                        np.asarray(tatp.cf_key(
+                            rng.integers(1, n_sub + 1, n_rd),
+                            rng.integers(1, 5, n_rd),
+                            rng.integers(0, 3, n_rd) * 8)), rd_key)
+                    lk_key = rng.choice(hi - lo, n_lock,
+                                        replace=False) + lo
+                    types = np.concatenate([
+                        np.zeros(n_rd, np.uint8),
+                        np.ones(n_lock, np.uint8),
+                        np.full(n_ab, 2, np.uint8)])
+                    tbls = np.concatenate([
+                        rd_tbl, np.zeros(n_lock + n_ab, np.uint8)])
+                    keys = np.concatenate([
+                        rd_key.astype(np.uint64),
+                        lk_key.astype(np.uint64), prev_locks])
+                    t0 = time.monotonic()
+                    r = c.exchange(types, keys, tables=tbls,
+                                   timeout_ms=10_000)
+                    dt = time.monotonic() - t0
+                    sent[i] += len(types)
+                    answered[i] += r["n"]
+                    lats[i].add(np.full(r["n"], dt * 1e6))
+                    granted = r["key"][r["type"] == 7]   # kGrantLock
+                    grants[i] += len(granted)
+                    prev_locks = granted.astype(np.uint64)
+                # release what's still held so the run ends clean
+                if len(prev_locks):
+                    c.exchange(np.full(len(prev_locks), 2, np.uint8),
+                               prev_locks, timeout_ms=10_000)
+
+        t0 = time.time()
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(n_clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.time() - t0
+
+    agg = LatencyReservoir()
+    for lr in lats:
+        agg.add(lr.samples[:lr.n_kept])
+    p = agg.percentiles()
+    return MetricBlock(
+        throughput=float(sent.sum()) / dt,
+        goodput=float(answered.sum()) / dt,
+        avg_us=p["avg"], p50_us=p["p50"], p99_us=p["p99"],
+        p999_us=p["p999"],
+        extra={"unit": "pkt/s", "clients": n_clients, "wave": wave,
+               "lock_grants": int(grants.sum()),
+               "n_subscribers": n_sub,
+               "transport": "udp_loopback_shim"}).to_dict()
+
+
+def _colocate_bench(n_cores, window_s, quick):
+    """The reference's colocated-eBPF experiment analogue
+    (exp/run_tatp_colocate.sh:27 pins servers to 8 shared cores): restrict
+    the whole host process — C++ RX thread, wire parse, reply scatter,
+    dispatch — to ``n_cores`` and rerun the TATP wire bench. Threads
+    spawned inside inherit the affinity."""
+    all_cpus = os.sched_getaffinity(0)
+    os.sched_setaffinity(0, set(sorted(all_cpus)[:n_cores]))
+    from dint_tpu.stats import CpuMonitor
+
+    cpu = CpuMonitor()
+    try:
+        out = _tatp_wire_bench(window_s, quick)
+    finally:
+        os.sched_setaffinity(0, all_cpus)
+    out.update(cpu.cores())
+    out["host_cores_pinned"] = n_cores
+    return out
+
+
 OPEN_RATES = (0.25, 0.5, 0.75, 0.9, 1.1)
+
+
+class _ResultSink(dict):
+    """Results dict that persists each point to <out>/<name>.json the
+    moment it lands: a mid-sweep death (round 3: a tunnel outage escaping
+    an old pre-`run_point` warmup) leaves every finished point on disk
+    instead of voiding the sweep."""
+
+    def __init__(self, out: str):
+        super().__init__()
+        self.out = out
+
+    def __setitem__(self, name, block):
+        super().__setitem__(name, block)
+        with open(os.path.join(self.out, f"{name}.json"), "w") as f:
+            json.dump(block, f, indent=1)
 
 
 def run_all(out: str, window_s: float = 10.0, quick: bool = False,
             only: str | None = None) -> dict:
     _platform_override()
     os.makedirs(out, exist_ok=True)
-    results: dict[str, dict] = {}
+    results: dict[str, dict] = _ResultSink(out)
 
-    n_sub = 2_000 if quick else 100_000
-    n_acc = 20_000 if quick else 1_000_000
-    widths = [256] if quick else [2048, 8192, 32768]
+    # full sweep at the reference's workload scale: 7M subscribers
+    # (tatp/caladan/tatp.h:28), 24M accounts (smallbank.h:16); widths
+    # include 256/1024 to measure the latency floor at reduced load
+    n_sub = 2_000 if quick else int(os.environ.get(
+        "DINT_EXP_SUBSCRIBERS", 7_000_000))
+    n_acc = 20_000 if quick else int(os.environ.get(
+        "DINT_EXP_SB_ACCOUNTS", 24_000_000))
+    widths = [256] if quick else [256, 1024, 2048, 8192, 32768]
     cpb = 4
     rates = OPEN_RATES[1::2] if quick else OPEN_RATES
 
@@ -507,9 +681,6 @@ def run_all(out: str, window_s: float = 10.0, quick: bool = False,
                        window_s=window_s, open_rates=rates, results=results)
     sweep_micro(window_s, quick, results, want=want)  # self-gates per point
 
-    for name, block in results.items():
-        with open(os.path.join(out, f"{name}.json"), "w") as f:
-            json.dump(block, f, indent=1)
     summary = {"configs": sorted(results),
                "window_s": window_s, "quick": quick}
     with open(os.path.join(out, "summary.json"), "w") as f:

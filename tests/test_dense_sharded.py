@@ -47,12 +47,12 @@ def test_backups_mirror_primaries_and_logs_replicate():
     n1 = td.n_rows(n_loc) + 1
 
     meta = np.asarray(state.db.meta)          # [D, n1]
-    val = np.asarray(state.db.val)            # [D, n1, VW]
+    val = np.asarray(state.db.val).reshape(D, -1, VW)   # [D, n1, VW]
     bck_meta = np.asarray(state.bck_meta)     # [D, 2*n1]
     bck_val = np.asarray(state.bck_val)       # [D, 2*n1*VW]
 
-    assert (meta & 1).sum() == 0              # all locks released
-    wrote = (meta >> 2) > 1                   # rows written past populate
+    assert not np.asarray(state.db.locked).any()   # all stamps expired
+    wrote = (meta >> 1) > 1                   # rows written past populate
     assert wrote.any()
     for d in range(D):
         for off, slot in ((1, 0), (2, 1)):
@@ -61,20 +61,18 @@ def test_backups_mirror_primaries_and_logs_replicate():
             bv = bck_val[holder, slot * n1 * VW:(slot + 1) * n1 * VW]
             bv = bv.reshape(n1, VW)
             rows = np.nonzero(wrote[d])[0]
-            assert np.array_equal(bm[rows], meta[d, rows] >> 1), (d, off)
+            assert np.array_equal(bm[rows], meta[d, rows]), (d, off)
             assert np.array_equal(bv[rows], val[d, rows]), (d, off)
 
     # replicated logging: every write appended on 3 devices
     heads = np.asarray(state.db.log.head).sum()
-    writes = int((meta >> 2).astype(np.int64).sum()
-                 - D * (n1 - 1))              # ver bumps past populate...
     # deleted rows bumped ver but exists=0; every bump logged once per
     # device x3 replicas-over-devices. ver counts bumps exactly.
     vers0 = []
     for d in range(D):
         db0 = td.populate(np.random.default_rng(d), n_loc, val_words=VW)
-        vers0.append(np.asarray(db0.meta) >> 2)
-    bumps = int(sum((meta[d].astype(np.int64) >> 2).sum()
+        vers0.append(np.asarray(db0.meta) >> 1)
+    bumps = int(sum((meta[d].astype(np.int64) >> 1).sum()
                     - vers0[d].astype(np.int64).sum() for d in range(D)))
     assert heads == 3 * bumps, (heads, bumps)
 
@@ -112,9 +110,8 @@ def test_lost_device_recovers_from_any_log_stream():
                                               key_hi_filter=tag)
             assert np.array_equal(np.asarray(rec.val), val[dead]), \
                 (dead, holder, tag)
-            got = np.asarray(rec.meta) & ~np.uint32(1)
-            want = meta[dead] & ~np.uint32(1)
-            assert np.array_equal(got, want), (dead, holder, tag)
+            assert np.array_equal(np.asarray(rec.meta), meta[dead]), \
+                (dead, holder, tag)
 
 
 def test_uneven_partition_rounds_up():

@@ -35,6 +35,8 @@ def test_accounting_closes_and_balance_conserved_globally():
     assert committed > 0
     assert committed + int(total[dsb.STAT_AB_LOCK]) \
         + int(total[dsb.STAT_AB_LOGIC]) == attempted
+    # routing slack holds: no destination bucket overflowed at this width
+    assert int(total[dsb.STAT_OVERFLOW]) == 0
     final = dsb.total_balance_global(state)
     want = int(total[dsb.STAT_BAL_DELTA])
     assert (final - base) % (1 << 32) == want % (1 << 32)
@@ -98,5 +100,17 @@ def test_lost_device_balance_range_recovers_from_any_ring():
         for holder in (dead, (dead + 1) % D, (dead + 2) % D):
             rec = recovery.recover_sb_shard(
                 n_accounts, dead, D,
-                entries[holder].reshape(lanes, cap, -1), heads[holder])
+                entries[holder].reshape(lanes, cap, -1), heads[holder],
+                ring_owner=holder)
             assert np.array_equal(rec, bal[dead]), (dead, holder)
+
+    # geometry check: the key_hi source tags expose a ring replayed under
+    # the wrong n_shards (here: wrong ring_owner stands in for geometry
+    # drift — tags no longer match acct % D)
+    import pytest
+
+    wrong = (1 + 3) % D
+    with pytest.raises(ValueError, match="source tags"):
+        recovery.recover_sb_shard(
+            n_accounts, 1, D, entries[1].reshape(lanes, cap, -1),
+            heads[1], ring_owner=wrong)

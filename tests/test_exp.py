@@ -12,20 +12,37 @@ def test_quick_tatp_sweep(tmp_path):
     names = sorted(results)
     assert any(n.startswith("tatp_closed_w") for n in names)
     assert any(n.startswith("tatp_open_") for n in names)
+    # the wire + colocate points are gated in by `only in name` too
+    assert "tatp_wire" in names
+    assert any(n.startswith("tatp_colocate_c") for n in names)
 
+    measured = 0
     for name, block in results.items():
-        # every point carries the reference metric contract
+        # a point may legitimately be an error artifact (run_point's
+        # record-and-continue fault tolerance — e.g. a loaded CI box
+        # starving a core-pinned colocate point); measured points must
+        # carry the full reference metric contract
+        if "error" in block:
+            continue
+        measured += 1
         for field in ("throughput", "goodput", "abort_rate", "avg_us",
                       "p50_us", "p99_us", "p999_us"):
             assert field in block, (name, field)
         assert block["goodput"] > 0
         assert block["p99_us"] >= block["p50_us"] >= 0
-        # abort breakdown travels with every TATP point
-        for field in ("ab_lock", "ab_missing", "ab_validate"):
-            assert field in block, (name, field)
-        # one JSON file per config
+        if name.startswith(("tatp_closed", "tatp_open")):
+            # abort breakdown travels with every pipeline TATP point
+            for field in ("ab_lock", "ab_missing", "ab_validate"):
+                assert field in block, (name, field)
+        # one JSON file per config, written the moment the point landed
         with open(os.path.join(out, f"{name}.json")) as f:
             assert json.load(f) == block
+    # the closed/open pipeline points must actually measure (they carry
+    # the sweep's anchor); only the wire/colocate extras may error out
+    pipeline_pts = [n for n in names
+                    if n.startswith(("tatp_closed", "tatp_open"))]
+    assert all("error" not in results[n] for n in pipeline_pts), pipeline_pts
+    assert measured >= len(pipeline_pts)
 
     with open(os.path.join(out, "summary.json")) as f:
         summary = json.load(f)

@@ -144,7 +144,10 @@ def test_smallbank_wire_lock_commit_roundtrip(rng):
             bal = int(np.frombuffer(r["val"][0][:4].tobytes(),
                                     np.uint32)[0])
             assert bal == 100
-            # kCommitPrim (4) installs bal 250 + releases the row lock
+            # kCommitPrim (4) installs bal 250; release is the
+            # coordinator's SEPARATE final kReleaseExclusive phase
+            # (smallbank/caladan/proto.h:19-20) — the row stays X-held,
+            # asserted by the REJECT below
             nv = np.zeros((1, 40), np.uint8)
             nv[0, :4] = np.frombuffer(np.uint32(250).tobytes(), np.uint8)
             nv[0, 4:8] = np.frombuffer(np.uint32(wl.SB_MAGIC).tobytes(),
@@ -178,3 +181,106 @@ def test_smallbank_wire_lock_commit_roundtrip(rng):
             bal = int(np.frombuffer(r["val"][0][:4].tobytes(),
                                     np.uint32)[0])
             assert bal == 250
+
+
+def test_tatp_wire_occ_roundtrip(rng):
+    """TATP over the reference 55-byte wire format through the pump — the
+    path the reference serves with tatp/udp/server_shard.cc: kRead with
+    bloom-negative NOT_EXIST, kAcquireLock CAS, kCommitPrim install +
+    row-lock release, kAbort release (tatp/ebpf/utils.h:38-73 codes;
+    handler tatp/caladan/server_shard.cc:131-230)."""
+    from dint_tpu.clients import tatp_client as tc
+    from dint_tpu.engines import tatp
+    from dint_tpu.shim import TATP
+
+    shard = tc.populate_shards(np.random.default_rng(0), 64,
+                               val_words=10)[0][0]
+    sub = np.array([tatp.SUBSCRIBER], np.uint8)
+    k5 = np.array([5], np.uint64)
+    with EnginePump(TATP, tatp.step, shard, width=128,
+                    flush_us=2000).start() as p:
+        _warm(p)
+        with ShimClient("127.0.0.1", p.port) as c:
+            # kRead (0) SUBSCRIBER 5 -> kGrantRead (4) with payload + ver
+            r = c.exchange(np.zeros(1, np.uint8), k5, tables=sub,
+                           timeout_ms=5000)
+            assert r["n"] == 1 and r["type"][0] == 4
+            assert int(np.frombuffer(r["val"][0][:4].tobytes(),
+                                     np.uint32)[0]) == 5
+            ver1 = int(r["ver"][0])
+            assert ver1 >= 1
+            # kRead on an absent CALL_FORWARDING row -> kNotExist (6)
+            r = c.exchange(np.zeros(1, np.uint8),
+                           np.array([tatp.cf_key(9, 1, 0)], np.uint64),
+                           tables=np.array([tatp.CALL_FORWARDING],
+                                           np.uint8), timeout_ms=5000)
+            assert r["n"] == 1 and r["type"][0] == 6
+            # kAcquireLock (1) -> kGrantLock (7); a second -> kRejectLock (8)
+            r = c.exchange(np.ones(1, np.uint8), k5, tables=sub,
+                           timeout_ms=5000)
+            assert r["n"] == 1 and r["type"][0] == 7
+            r = c.exchange(np.ones(1, np.uint8), k5, tables=sub,
+                           timeout_ms=5000)
+            assert r["n"] == 1 and r["type"][0] == 8
+            # kCommitPrim (12) installs AND releases the row lock
+            # (shard_kern.c:338-476)
+            nv = np.zeros((1, 40), np.uint8)
+            nv[0, :4] = np.frombuffer(np.uint32(777).tobytes(), np.uint8)
+            r = c.exchange(np.array([12], np.uint8), k5, vals=nv,
+                           vers=np.array([ver1 + 1], np.uint32),
+                           tables=sub, timeout_ms=5000)
+            assert r["n"] == 1 and r["type"][0] == 15   # kCommitPrimAck
+            # re-read: new payload, bumped version
+            r = c.exchange(np.zeros(1, np.uint8), k5, tables=sub,
+                           timeout_ms=5000)
+            assert r["n"] == 1 and r["type"][0] == 4
+            assert int(np.frombuffer(r["val"][0][:4].tobytes(),
+                                     np.uint32)[0]) == 777
+            assert int(r["ver"][0]) == ver1 + 1
+            # lock free again: grant then kAbort (2) -> kAbortAck (9)
+            r = c.exchange(np.ones(1, np.uint8), k5, tables=sub,
+                           timeout_ms=5000)
+            assert r["n"] == 1 and r["type"][0] == 7
+            r = c.exchange(np.array([2], np.uint8), k5, tables=sub,
+                           timeout_ms=5000)
+            assert r["n"] == 1 and r["type"][0] == 9
+
+
+def test_fasst_wire_occ_roundtrip(rng):
+    """FaSST OCC over the 9-byte wire format {type, lid u32, ver u32}
+    (lock_fasst/caladan/proto.h:32-36): READ returns the version,
+    ACQUIRE_LOCK CAS grants then rejects, COMMIT bumps ver + unlocks,
+    ABORT unlocks (lock_fasst/ebpf/ls_kern.c:58-97)."""
+    from dint_tpu.engines import fasst
+    from dint_tpu.shim import FASST, FMT_FASST9
+    from dint_tpu.tables import locks
+
+    table = locks.create_occ(1 << 10)
+    lid = np.array([17], np.uint64)
+    with EnginePump(FASST, fasst.step, table, width=64,
+                    flush_us=2000).start() as p:
+        _warm(p, fmt=FMT_FASST9)
+        with ShimClient("127.0.0.1", p.port, fmt=FMT_FASST9) as c:
+            # READ (0) -> GRANT_READ (4), ver 0
+            r = c.exchange(np.zeros(1, np.uint8), lid, timeout_ms=5000)
+            assert r["n"] == 1 and r["type"][0] == 4
+            assert int(r["ver"][0]) == 0
+            # ACQUIRE_LOCK (1) -> GRANT_LOCK (5); second -> REJECT_LOCK (6)
+            r = c.exchange(np.ones(1, np.uint8), lid, timeout_ms=5000)
+            assert r["n"] == 1 and r["type"][0] == 5
+            r = c.exchange(np.ones(1, np.uint8), lid, timeout_ms=5000)
+            assert r["n"] == 1 and r["type"][0] == 6
+            # COMMIT (3) -> COMMIT_ACK (8): ver++ and unlock
+            r = c.exchange(np.array([3], np.uint8), lid, timeout_ms=5000)
+            assert r["n"] == 1 and r["type"][0] == 8
+            r = c.exchange(np.zeros(1, np.uint8), lid, timeout_ms=5000)
+            assert r["n"] == 1 and r["type"][0] == 4
+            assert int(r["ver"][0]) == 1
+            # lock again (freed by COMMIT), then ABORT (2) -> ABORT_ACK (7)
+            r = c.exchange(np.ones(1, np.uint8), lid, timeout_ms=5000)
+            assert r["n"] == 1 and r["type"][0] == 5
+            r = c.exchange(np.array([2], np.uint8), lid, timeout_ms=5000)
+            assert r["n"] == 1 and r["type"][0] == 7
+            # and the slot is lockable again after the abort release
+            r = c.exchange(np.ones(1, np.uint8), lid, timeout_ms=5000)
+            assert r["n"] == 1 and r["type"][0] == 5

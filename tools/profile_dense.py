@@ -102,7 +102,7 @@ def main():
     def gathers(c):
         db_, rws = c
         m = db_.meta[rws.reshape(W, K)]
-        g = db_.val[rws.reshape(W, K), 1]
+        g = db_.val[rws.reshape(W, K) * VW + 1]
         return (db_, rws + (m.sum() + g.sum()).astype(I32) * 0)
 
     timeit("gathers meta+magic [wK]", gathers, (db, rows))
@@ -112,22 +112,29 @@ def main():
         db_, wr = c
         meta = db_.meta.at[wr].set(newval[:, 0], mode="drop",
                                    unique_indices=True)
-        val = db_.val.at[wr].set(newval, mode="drop", unique_indices=True)
+        wflat = (wr[:, None] * VW + jnp.arange(VW, dtype=I32)).reshape(-1)
+        val = db_.val.at[wflat].set(newval.reshape(-1), mode="drop",
+                                    unique_indices=True)
         return (db_.replace(val=val, meta=meta), wr)
 
     timeit("install scatters meta+val", installs, (db, wrows))
 
-    # 4. lock arbitration over [2w] write slots
+    # 4. lock arbitration over [2w] write slots (step-stamped arb array:
+    # gather -> masked scatter-max -> gather-back, no meta involvement)
     def arb(c):
         db_, wr = c
-        lane2 = jnp.arange(2 * W, dtype=I32)
-        winner = jnp.full((n1,), BIG, I32).at[wr].min(lane2, mode="drop")
-        grant = (winner[wr] == lane2) & ((db_.meta[wr] & 1) == 0)
-        meta = db_.meta.at[jnp.where(grant, wr, n1)].set(
-            U32(1), mode="drop", unique_indices=True)
-        return (db_.replace(meta=meta), wr)
+        t = db_.step
+        old = db_.arb[wr]
+        held = (old >> td.K_ARB) == (t - 1)
+        inv = U32(2 * W - 1) - jnp.arange(2 * W, dtype=U32)
+        packed = (t << td.K_ARB) | inv
+        a = db_.arb.at[jnp.where(~held, wr, n1)].max(packed, mode="drop")
+        grant = ~held & (a[wr] == packed)
+        return (db_.replace(arb=a,
+                            step=t + 1 + grant.sum(dtype=U32) * U32(0)),
+                wr)
 
-    timeit("lock arb scatter-min [2w]", arb, (db, wrows))
+    timeit("lock arb stamp scatter-max [2w]", arb, (db, wrows))
 
     # 5. replicated log append (RepLog: one unique row scatter)
     def logs(c):
