@@ -166,9 +166,12 @@ def _child_main():
         "throughput": round(attempted / dt, 1),
         "abort_rate": round(1 - committed / max(attempted, 1), 5),
         # aborts from lock/validate conflicts only: the number comparable
-        # to the reference's abort rate. ab_missing is TATP semantics
-        # (GET_NEW_DEST's ~62% miss rate, insert-exists, absent CF rows)
-        # and dominates abort_rate at every contention level.
+        # to the reference's abort rate. ab_missing is TATP semantics —
+        # GET_ACCESS / GET_NEW_DEST / CF txns fail on absent rows BY
+        # DESIGN (~25% analytic floor, pinned in
+        # test_ab_missing_matches_population_analytics) — and dominates
+        # abort_rate at every contention level, exactly as in the
+        # reference's goodput accounting (client_ebpf_shard.cc:583-587).
         "contention_abort_rate": round(
             float(total[td.STAT_AB_LOCK] + total[td.STAT_AB_VALIDATE])
             / max(attempted, 1), 5),

@@ -236,6 +236,8 @@ class Coordinator:
 
         # required-row checks
         missing = np.zeros(w, bool)
+        m = t == wl.TATP_GET_ACCESS       # ai row must exist (cc:583-587)
+        missing |= m & (r_rt[:, 0] != Reply.VAL)
         m = t == wl.TATP_GET_NEW_DEST     # sf AND cf must exist
         missing |= m & ((r_rt[:, 0] != Reply.VAL)
                         | (r_rt[:, 1] != Reply.VAL))

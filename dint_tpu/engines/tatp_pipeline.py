@@ -394,6 +394,11 @@ def classify_wave1(ttype, rt, ops, ws_active, ws_lane, ws_rt=None):
     lock_rejected = (ws_active & rejected).any(axis=1)
 
     missing = jnp.zeros(t.shape, bool)
+    # GET_ACCESS fails on an absent ACCESS_INFO row — kNotExist returns
+    # false, excluded from goodput (client_ebpf_shard.cc:583-587); by the
+    # 0.625 population this fails ~37% of the time BY DESIGN (TATP spec)
+    m = t == wl.TATP_GET_ACCESS
+    missing |= m & (rt[:, 0] != Reply.VAL)
     # GET_NEW_DEST succeeds only when the SPECIAL_FACILITY row exists AND
     # the CALL_FORWARDING read hits (client_ebpf_shard.cc:492,549-563 —
     # kNotExist on either ends the txn unsuccessfully; the reference's

@@ -609,12 +609,14 @@ def _colocate_bench(n_cores, window_s, quick):
     the whole host process — C++ RX thread, wire parse, reply scatter,
     dispatch — to ``n_cores`` and rerun the TATP wire bench. Threads
     spawned inside inherit the affinity."""
-    all_cpus = os.sched_getaffinity(0)
-    os.sched_setaffinity(0, set(sorted(all_cpus)[:n_cores]))
     from dint_tpu.stats import CpuMonitor
 
+    all_cpus = os.sched_getaffinity(0)
     cpu = CpuMonitor()
     try:
+        # inside the try: an exception anywhere after narrowing must not
+        # leave the rest of the sweep pinned
+        os.sched_setaffinity(0, set(sorted(all_cpus)[:n_cores]))
         out = _tatp_wire_bench(window_s, quick)
     finally:
         os.sched_setaffinity(0, all_cpus)

@@ -48,9 +48,10 @@ def test_low_contention_mostly_commits():
     db, total = _run(n_sub=20_000, w=64, blocks=3)
     attempted = int(total[td.STAT_ATTEMPTED])
     committed = int(total[td.STAT_COMMITTED])
-    # abort rate ~= the analytic ab_missing floor (~12%, see
-    # test_ab_missing_matches_population_analytics) + ~0 contention
-    assert 1 - committed / attempted < 0.16
+    # abort rate ~= the analytic ab_missing floor (~25%, see
+    # test_ab_missing_matches_population_analytics — TATP's read txns
+    # fail on absent rows BY DESIGN) + ~0 contention
+    assert 1 - committed / attempted < 0.30
     contention = int(total[td.STAT_AB_LOCK]) + int(total[td.STAT_AB_VALIDATE])
     assert contention / attempted < 0.01, total
     assert int(total[td.STAT_MAGIC_BAD]) == 0
@@ -61,13 +62,14 @@ def test_ab_missing_matches_population_analytics():
     workload semantics, not a lookup bug, by pinning observed rates to the
     analytic expectations of the population rules + txn mix:
 
-      P(sf present)  p_sf = 0.625 + 0.375^4/4   (the >=1-per-sub fix)
-      P(cf present)  p_cf = p_sf * 0.25
+      P(ai/sf present)  p_sf = 0.625 + 0.375^4/4   (the >=1-per-sub fix)
+      P(cf present)     p_cf = p_sf * 0.25
+      GET_ACCESS   (35%) misses at 1 - p_sf          (ai row required)
       GET_NEW_DEST (10%) misses at 1 - p_cf          (sf AND cf required)
       UPDATE_SUB    (2%) misses at 1 - p_sf          (sub always present)
       INSERT_CF     (2%) misses at 1 - p_sf*0.75     (cf must NOT exist)
       DELETE_CF     (2%) misses at 1 - p_cf          (cf must exist)
-      others        (84%) never miss
+      others        (49%) never miss
 
     Few blocks over a fresh populate so insert/delete drift of CF
     occupancy stays negligible."""
@@ -78,7 +80,8 @@ def test_ab_missing_matches_population_analytics():
 
     p_sf = 0.625 + 0.375 ** 4 / 4
     p_cf = p_sf * 0.25
-    expected = (0.10 * (1 - p_cf)
+    expected = (0.35 * (1 - p_sf)
+                + 0.10 * (1 - p_cf)
                 + 0.02 * (1 - p_sf)
                 + 0.02 * (1 - p_sf * 0.75)
                 + 0.02 * (1 - p_cf))
@@ -124,6 +127,45 @@ def test_insert_mix_fills_cf_and_versions_are_monotonic():
     cf1 = np.asarray(db.exists)[10 * (n_sub + 1):-1].sum()
     assert int(total[td.STAT_COMMITTED]) == cf1 - cf0
     assert int(total[td.STAT_MAGIC_BAD]) == 0
+
+
+def test_rebase_stamps_preserves_lock_state():
+    """rebase_stamps fires only after ~12k steps on hardware; pin its
+    remap directly: live stamps (step-1 held, step-2 expiring) keep their
+    held/free meaning and slot fields, older stamps zero."""
+    n_sub = 8
+    db = td.populate(np.random.default_rng(0), n_sub, val_words=VW)
+    t = np.uint32(td.REBASE_AT + 7)
+    arb = np.zeros(td.n_rows(n_sub) + 1, np.uint32)
+    arb[3] = ((t - 1) << td.K_ARB) | 11       # held (stamped last step)
+    arb[5] = ((t - 2) << td.K_ARB) | 22       # expiring this step
+    arb[7] = ((t - 3) << td.K_ARB) | 33       # stale
+    db = db.replace(arb=jax.numpy.asarray(arb),
+                    step=jax.numpy.asarray(t, jax.numpy.uint32))
+    held_before = np.asarray(db.locked)
+
+    db2 = td.rebase_stamps(db)
+    assert int(np.asarray(db2.step)) == 3
+    arb2 = np.asarray(db2.arb)
+    assert np.array_equal(np.asarray(db2.locked), held_before)
+    assert arb2[3] == (2 << td.K_ARB) | 11    # held -> step 2, slot kept
+    assert arb2[5] == (1 << td.K_ARB) | 22    # expiring -> step 1
+    assert arb2[7] == 0                       # stale zeroed
+    assert (arb2[np.arange(len(arb2)) % 2 == 0] == 0).all()
+
+    # and the engine keeps running correctly from a rebased state: the
+    # next steps' grants/stats still close
+    run, init, drain = td.build_pipelined_runner(n_sub, w=16, val_words=VW,
+                                                 cohorts_per_block=2)
+    carry = init(db)
+    carry, s = run(carry, jax.random.PRNGKey(0))
+    tot = np.asarray(s, np.int64).sum(axis=0)
+    _, tail = drain(carry)
+    tot += np.asarray(tail, np.int64).sum(axis=0)
+    outcomes = (tot[td.STAT_COMMITTED] + tot[td.STAT_AB_LOCK]
+                + tot[td.STAT_AB_MISSING] + tot[td.STAT_AB_VALIDATE])
+    assert outcomes == tot[td.STAT_ATTEMPTED]
+    assert int(tot[td.STAT_MAGIC_BAD]) == 0
 
 
 def test_populate_device_matches_population_rules():

@@ -59,13 +59,13 @@ def test_low_contention_mostly_commits():
     attempted = int(total[tp.STAT_ATTEMPTED])
     committed = int(total[tp.STAT_COMMITTED])
     rate = 1 - committed / attempted
-    # ab_missing is population-driven, not contention: GET_NEW_DEST /
-    # DELETE_CF hit absent SF/CF rows by TATP spec (~12% of the mix fails
-    # row lookups regardless of load — the reference counts these as
-    # unsuccessful txns too, tatp/caladan/client_ebpf_shard.cc:567-596;
-    # analytic expectation pinned in
-    # test_tatp_dense.test_ab_missing_matches_population_analytics)
-    assert rate < 0.16, rate
+    # ab_missing is population-driven, not contention: GET_ACCESS /
+    # GET_NEW_DEST / DELETE_CF hit absent AI/SF/CF rows by TATP spec
+    # (~25% of the mix fails row lookups regardless of load — the
+    # reference counts these as unsuccessful txns too,
+    # tatp/caladan/client_ebpf_shard.cc:567-596; analytic expectation
+    # pinned in test_tatp_dense.test_ab_missing_matches_population_analytics)
+    assert rate < 0.30, rate
     # the CONTENTION aborts are what low load must keep near zero
     contention = int(total[tp.STAT_AB_LOCK]) + int(total[tp.STAT_AB_VALIDATE])
     assert contention / attempted < 0.01, total
