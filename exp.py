@@ -334,6 +334,10 @@ def sweep_micro(window_s, quick, results, want=lambda name: True):
         run_point(results, "tatp_wire",
                   lambda: _tatp_wire_bench(window_s, quick))
 
+    if want("tatp_wire_txn"):
+        run_point(results, "tatp_wire_txn",
+                  lambda: _tatp_wire_txn_bench(window_s, quick))
+
     # colocate analogue (exp/run_tatp_colocate.sh:27: servers share 8
     # cores): pin THIS process — pump RX thread, batch parse, reply
     # serialization, dispatch loop — to N cores and re-measure the wire
@@ -601,6 +605,47 @@ def _tatp_wire_bench(window_s, quick):
                "lock_grants": int(grants.sum()),
                "n_subscribers": n_sub,
                "transport": "udp_loopback_shim"}).to_dict()
+
+
+def _tatp_wire_txn_bench(window_s, quick):
+    """FULL TATP transactions over the wire: 3 UDP shard servers + the
+    wave coordinator fanning per-shard datagram batches — the reference's
+    actual serving topology (3 servers + Caladan client,
+    client_ebpf_shard.cc:636-677), txn/s with the abort taxonomy. This is
+    the protocol-fidelity point; the device-fused pipeline remains the
+    throughput path (bench.py)."""
+    from dint_tpu.clients import tatp_wire as tw
+
+    n_sub = 2_000 if quick else 100_000
+    w = 128 if quick else 512
+
+    from dint_tpu.stats import LatencyReservoir, MetricBlock
+
+    lat = LatencyReservoir()
+    with tw.serve_shards(n_sub, width=4 * w, flush_us=500) as ports:
+        with tw.WireCoordinator(ports, n_sub, width=4 * w) as coord:
+            rng = np.random.default_rng(0)
+            coord.run_cohort(rng, w)            # compile all wave shapes
+            coord.stats = type(coord.stats)()
+            t0 = time.time()
+            while time.time() - t0 < window_s:
+                c0 = time.monotonic()
+                coord.run_cohort(rng, w)
+                # closed-loop: a txn's latency is its cohort's full
+                # multi-wave wall span (all RTTs + certify steps)
+                lat.add(np.full(w, (time.monotonic() - c0) * 1e6))
+            dt = time.time() - t0
+            st = coord.stats
+
+    p = lat.percentiles()
+    return MetricBlock(
+        throughput=st.attempted / dt, goodput=st.committed / dt,
+        avg_us=p["avg"], p50_us=p["p50"], p99_us=p["p99"],
+        p999_us=p["p999"],
+        extra={"unit": "txn/s", "width": w, "n_subscribers": n_sub,
+               "ab_lock": st.aborted_lock, "ab_missing": st.aborted_missing,
+               "ab_validate": st.aborted_validate,
+               "transport": "udp_loopback_3shard"}).to_dict()
 
 
 def _colocate_bench(n_cores, window_s, quick):

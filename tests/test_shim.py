@@ -284,3 +284,27 @@ def test_fasst_wire_occ_roundtrip(rng):
             # and the slot is lockable again after the abort release
             r = c.exchange(np.ones(1, np.uint8), lid, timeout_ms=5000)
             assert r["n"] == 1 and r["type"][0] == 5
+
+
+def test_tatp_full_transactions_over_wire():
+    """FULL TATP transactions over the wire against 3 UDP shard servers —
+    the reference's client/server topology (3 server processes + a
+    coordinator fanning per-shard batches, client_ebpf_shard.cc:636-677)
+    in-process: every phase (read+lock, validate, log x3, bck x2, prim,
+    abort) crosses loopback datagrams in the 55-byte format."""
+    from dint_tpu.clients import tatp_wire as tw
+
+    with tw.serve_shards(200, width=256, flush_us=1000) as ports:
+        with tw.WireCoordinator(ports, 200, width=256) as coord:
+            rng = np.random.default_rng(0)
+            for _ in range(3):
+                coord.run_cohort(rng, 64)
+            st = coord.stats
+            assert st.attempted == 3 * 64
+            assert st.committed > 0
+            # outcome taxonomy closes
+            assert (st.committed + st.aborted_lock + st.aborted_validate
+                    + st.aborted_missing) == st.attempted
+            # population-driven miss floor is ~25% of the mix; leave slack
+            # for the tiny keyspace's contention
+            assert st.committed > st.attempted * 0.45

@@ -1,0 +1,23 @@
+#!/bin/bash
+# Round-4 hardware measurement plan — run the moment the axon tunnel is up.
+# Priority order so a flaky window still yields the highest-value
+# artifacts first; every stage persists its own durable output
+# (bench.py -> artifacts/BENCH_<commit>_<ts>.json; exp.py -> one JSON per
+# point the moment it lands).
+cd "$(dirname "$0")/.." || exit 1
+
+echo "=== stage 1: headline bench (7M subscribers + SmallBank pair) ==="
+DINT_BENCH_PROFILE=1 timeout 3000 python bench.py \
+    > bench_out.json 2> bench_stderr.log
+tail -1 bench_out.json
+
+echo "=== stage 2: full sweep matrix ==="
+timeout 14400 python exp.py --out exp_results 2> exp_run.log
+ls exp_results/ | wc -l
+
+echo "=== stage 3: component profile (new arb path) ==="
+timeout 1200 python tools/profile_dense.py 8192 100000 \
+    > profile_out.log 2>&1 || true
+tail -12 profile_out.log
+
+echo "=== done ==="
