@@ -39,6 +39,7 @@ import numpy as np
 from ..engines import tatp
 from ..engines.types import Op, Reply
 from ..shim import TATP, EnginePump, ShimClient
+from ..shim.native import VAL_SIZE
 from . import tatp_client as tc
 
 N_SHARDS = tc.N_SHARDS
@@ -118,41 +119,47 @@ class WireCoordinator(tc.Coordinator):
         rver = np.zeros(m, np.uint32)
         wire_req = _OP2WIRE[ops]
         for lo in range(0, m, _CHUNK):
-            sel = np.arange(lo, min(lo + _CHUNK, m))
-            pend = sel
+            chunk = np.arange(lo, min(lo + _CHUNK, m))
+            pend = chunk
             for _ in range(self.max_tries):
                 if len(pend) == 0:
                     break
-                wv = np.zeros((len(pend), 40), np.uint8)
+                wv = np.zeros((len(pend), VAL_SIZE), np.uint8)
                 wv[:, : self.vw * 4] = np.ascontiguousarray(
                     vals[pend, : self.vw].astype(np.uint32)
                 ).view(np.uint8).reshape(len(pend), -1)
+                # ords are STABLE across retries (lane's position within
+                # its original chunk), so a straggler reply from an
+                # earlier try always maps back to the lane that sent it —
+                # per-try renumbering could mis-credit a same-key lane
                 r = self.clients[s].exchange(
                     wire_req[pend], keys[pend].astype(np.uint64),
                     tables=tbls[pend].astype(np.uint8), vals=wv,
                     vers=vers[pend].astype(np.uint32),
-                    ords=(np.arange(len(pend)) % 256).astype(np.uint8),
+                    ords=(pend - lo).astype(np.uint8),
                     timeout_ms=self.timeout_ms)
                 n = r["n"]
                 if n == 0:
                     continue
-                # discard late stragglers from a timed-out earlier try:
-                # the echoed ord must address THIS try's pend array and
-                # the echoed key/table must match what that slot sent
+                # ord -> lane within the chunk; sanity-check the echoed
+                # key/table against what that lane sent (the reference's
+                # assert(msg.key == key) pattern) and drop mismatches
                 ordv = r["ord"][:n].astype(np.int64)
-                ok = ordv < len(pend)
-                cand = pend[np.where(ok, ordv, 0)]
+                ok = ordv < len(chunk)
+                cand = chunk[np.where(ok, ordv, 0)]
                 ok &= (r["key"][:n] == keys[cand].astype(np.uint64)) \
                     & (r["table"][:n] == tbls[cand].astype(np.uint8))
                 idx = cand[ok]
-                sel_n = np.nonzero(ok)[0]
-                rt[idx] = _WIRE2REP[wire_req[idx], r["type"][:n][sel_n]]
-                got_v = r["val"][:n][sel_n].reshape(len(sel_n), -1)
-                rv[idx] = np.ascontiguousarray(
-                    got_v[:, : self.vw * 4]).view(np.uint32).reshape(
-                        len(sel_n), -1)
-                rver[idx] = r["ver"][:n][sel_n]
-                pend = pend[~np.isin(pend, idx)]
+                if len(idx):
+                    sel_n = np.nonzero(ok)[0]
+                    rt[idx] = _WIRE2REP[wire_req[idx], r["type"][:n][sel_n]]
+                    got_v = r["val"][:n][sel_n].reshape(len(sel_n),
+                                                        VAL_SIZE)
+                    rv[idx] = np.ascontiguousarray(
+                        got_v[:, : self.vw * 4]).view(np.uint32).reshape(
+                            len(sel_n), -1)
+                    rver[idx] = r["ver"][:n][sel_n]
+                    pend = pend[~np.isin(pend, idx)]
             if len(pend):
                 raise RuntimeError(
                     f"shard {s}: {len(pend)} lanes unanswered after "

@@ -106,7 +106,8 @@ def step(table: kv.KVTable, batch: Batch, *, maintain_bloom: bool = False):
     alloc2 = o_alloc[sb2.perm]
     rank_alloc = segments.seg_cumsum_excl(sb2, alloc2.astype(I32))
     bkt2 = o_bkt[sb2.perm]
-    has2, slot_new2 = kv.nth_free_slot(table.valid[bkt2], rank_alloc)
+    has2, slot_new2 = kv.nth_free_slot(
+        table.valid[kv.bucket_rows(table, bkt2)], rank_alloc)
     ok2 = alloc2 & has2
     spill2 = alloc2 & ~has2
     ok, spill1, slot_new = segments.unsort(sb2, ok2, spill2, slot_new2)
@@ -119,7 +120,8 @@ def step(table: kv.KVTable, batch: Batch, *, maintain_bloom: bool = False):
     sb3 = segments.sort_batch(jnp.zeros((r,), U32), o_alt.astype(U32))
     retry3 = spill1[sb3.perm]
     rank3 = segments.seg_cumsum_excl(sb3, retry3.astype(I32)) + taken[o_alt[sb3.perm]]
-    has3, slot_new3 = kv.nth_free_slot(table.valid[o_alt[sb3.perm]], rank3)
+    has3, slot_new3 = kv.nth_free_slot(
+        table.valid[kv.bucket_rows(table, o_alt[sb3.perm])], rank3)
     ok3_s = retry3 & has3
     ok_alt, slot_alt = segments.unsort(sb3, ok3_s, slot_new3)
     spill = spill1 & ~ok_alt
@@ -135,21 +137,25 @@ def step(table: kv.KVTable, batch: Batch, *, maintain_bloom: bool = False):
     rtype = jnp.where(seg_spill & is_delete, I32(Reply.NOT_EXIST), rtype)
     rver = jnp.where(seg_spill & is_install, U32(0), rver)
 
-    # ---- scatters (one writer per (bucket, slot); identical-value aliasing
-    # only for bloom recompute) --------------------------------------------
-    nb = table.n_buckets
+    # ---- scatters (flat 1-D unique-index: one writer per entry) ----------
+    ne = table.n_buckets * table.slots
+    s = table.slots
     w_any_slot = o_upd | ok | o_del
     t_slot = jnp.where(o_upd | o_del, o_slot0, slot_new)
-    safe_b = jnp.where(w_any_slot, o_bkt, nb)
-    new_valid = table.valid.at[safe_b, t_slot].set(~o_del, mode="drop")
+    e_any = jnp.where(w_any_slot, o_bkt * s + t_slot, ne)
+    new_valid = table.valid.at[e_any].set(~o_del, mode="drop",
+                                          unique_indices=True)
     wv = (o_upd | ok)
-    safe_bv = jnp.where(wv, o_bkt, nb)
     sl_v = jnp.where(o_upd, o_slot0, slot_new)
+    e_v = jnp.where(wv, o_bkt * s + sl_v, ne)
     table = table.replace(
-        key_hi=table.key_hi.at[safe_bv, sl_v].set(o_khi, mode="drop"),
-        key_lo=table.key_lo.at[safe_bv, sl_v].set(o_klo, mode="drop"),
-        val=table.val.at[safe_bv, sl_v].set(o_val, mode="drop"),
-        ver=table.ver.at[safe_bv, sl_v].set(o_ver, mode="drop"),
+        key_hi=table.key_hi.at[e_v].set(o_khi, mode="drop",
+                                        unique_indices=True),
+        key_lo=table.key_lo.at[e_v].set(o_klo, mode="drop",
+                                        unique_indices=True),
+        val=table.val.at[kv.val_word_idx(table, e_v)].set(
+            o_val.reshape(-1), mode="drop", unique_indices=True),
+        ver=table.ver.at[e_v].set(o_ver, mode="drop", unique_indices=True),
         valid=new_valid,
     )
     if maintain_bloom:

@@ -55,25 +55,27 @@ MISS = 100
 @flax.struct.dataclass
 class CacheTable:
     kv: kv.KVTable
-    dirty: jax.Array      # bool [NB, S]
+    dirty: jax.Array      # bool [NB*S] (flat entries, like kv.KVTable)
     clock: jax.Array      # u32 [] victim rotor (reference picks by slot scan)
 
 
 def create(n_buckets: int, slots: int = 4, val_words: int = 10) -> CacheTable:
     return CacheTable(kv=kv.create(n_buckets, slots, val_words),
-                      dirty=jnp.zeros((n_buckets, slots), bool),
+                      dirty=jnp.zeros((n_buckets * slots,), bool),
                       clock=U32(0))
 
 
 def _probe1(t: kv.KVTable, key_hi, key_lo, bkt):
     """Single-hash probe (the reference cache is single-hash 4-way)."""
-    rows_hi = t.key_hi[bkt]
-    rows_lo = t.key_lo[bkt]
-    rows_valid = t.valid[bkt]
+    rows = kv.bucket_rows(t, bkt)
+    rows_hi = t.key_hi[rows]
+    rows_lo = t.key_lo[rows]
+    rows_valid = t.valid[rows]
     match = rows_valid & (rows_hi == key_hi[:, None]) & (rows_lo == key_lo[:, None])
     hit = match.any(axis=-1)
     slot = jnp.argmax(match, axis=-1).astype(I32)
-    return hit, slot, t.val[bkt, slot], t.ver[bkt, slot]
+    eidx = bkt * t.slots + slot
+    return hit, slot, kv.entry_val(t, eidx), t.ver[eidx]
 
 
 def cache_step(cache: CacheTable, batch: Batch, *, policy: str = WB_BLOOM):
@@ -141,16 +143,17 @@ def cache_step(cache: CacheTable, batch: Batch, *, policy: str = WB_BLOOM):
     #    write-through SET invalidate (store_wt_kern.c:115-151) and the
     #    delete/insert paths in one rule.
     inval = sb.last & seg_miss & hit0
-    flush_mask = inval & cache.dirty[bkt, slot0]
+    flush_mask = inval & cache.dirty[bkt * t.slots + slot0]
     flush = {
         "mask": flush_mask,
         "key_hi": sb.key_hi.astype(U32), "key_lo": sb.key_lo.astype(U32),
         "val": val0, "ver": ver0,
     }
-    safe_i = jnp.where(inval, bkt, t.n_buckets)
+    ne = t.n_buckets * t.slots
+    e_i = jnp.where(inval, bkt * t.slots + slot0, ne)
     cache = cache.replace(
-        kv=t.replace(valid=t.valid.at[safe_i, slot0].set(False, mode="drop")),
-        dirty=cache.dirty.at[safe_i, slot0].set(False, mode="drop"))
+        kv=t.replace(valid=t.valid.at[e_i].set(False, mode="drop")),
+        dirty=cache.dirty.at[e_i].set(False, mode="drop"))
 
     # 2. write-back: the segment-last lane of a fully-local segment installs
     #    the last SET's value and marks the slot dirty
@@ -158,13 +161,15 @@ def cache_step(cache: CacheTable, batch: Batch, *, policy: str = WB_BLOOM):
         t2 = cache.kv
         writer = sb.last & ~seg_miss & (last_s >= 0) & hit0
         new_ver = ver0 + n_set_total.astype(U32)
-        safe_b = jnp.where(writer, bkt, t2.n_buckets)
+        e_w = jnp.where(writer, bkt * t2.slots + slot0,
+                        t2.n_buckets * t2.slots)
         cache = cache.replace(
             kv=t2.replace(
-                val=t2.val.at[safe_b, slot0].set(val_in[pos_last], mode="drop"),
-                ver=t2.ver.at[safe_b, slot0].set(new_ver, mode="drop"),
+                val=t2.val.at[kv.val_word_idx(t2, e_w)].set(
+                    val_in[pos_last].reshape(-1), mode="drop"),
+                ver=t2.ver.at[e_w].set(new_ver, mode="drop"),
             ),
-            dirty=cache.dirty.at[safe_b, slot0].set(True, mode="drop"),
+            dirty=cache.dirty.at[e_w].set(True, mode="drop"),
         )
 
     o_rtype, o_rver, o_miss = segments.unsort(sb, rtype, rver, miss)
@@ -198,33 +203,36 @@ def refill(cache: CacheTable, key_hi, key_lo, val, ver, bloom_hi, bloom_lo,
 
     has_rec = keep & (ver != 0)
     hit, slot_h, _, _ = _probe1(t, key_hi, key_lo, bkt)
-    rows_valid = t.valid[bkt]
+    rows_valid = t.valid[kv.bucket_rows(t, bkt)]
     free_any = (~rows_valid).any(axis=-1)
     first_free = jnp.argmax(~rows_valid, axis=-1).astype(I32)
     rotor = ((cache.clock + jnp.arange(r, dtype=U32)) % U32(t.slots)).astype(I32)
     victim = jnp.where(hit, slot_h, jnp.where(free_any, first_free, rotor))
+    e_vic = bkt * t.slots + victim
 
     ev_valid = has_rec & ~hit & ~free_any
-    ev_dirty = ev_valid & cache.dirty[bkt, victim]
+    ev_dirty = ev_valid & cache.dirty[e_vic]
     evicted = {
         "mask": ev_dirty,
-        "key_hi": t.key_hi[bkt, victim], "key_lo": t.key_lo[bkt, victim],
-        "val": t.val[bkt, victim], "ver": t.ver[bkt, victim],
+        "key_hi": t.key_hi[e_vic], "key_lo": t.key_lo[e_vic],
+        "val": kv.entry_val(t, e_vic), "ver": t.ver[e_vic],
     }
 
-    safe_b = jnp.where(has_rec, bkt, t.n_buckets)
+    ne = t.n_buckets * t.slots
+    e_r = jnp.where(has_rec, e_vic, ne)
     new = t.replace(
-        key_hi=t.key_hi.at[safe_b, victim].set(key_hi.astype(U32), mode="drop"),
-        key_lo=t.key_lo.at[safe_b, victim].set(key_lo.astype(U32), mode="drop"),
-        val=t.val.at[safe_b, victim].set(val, mode="drop"),
-        ver=t.ver.at[safe_b, victim].set(ver, mode="drop"),
-        valid=t.valid.at[safe_b, victim].set(True, mode="drop"),
+        key_hi=t.key_hi.at[e_r].set(key_hi.astype(U32), mode="drop"),
+        key_lo=t.key_lo.at[e_r].set(key_lo.astype(U32), mode="drop"),
+        val=t.val.at[kv.val_word_idx(t, e_r)].set(
+            val.reshape(-1), mode="drop"),
+        ver=t.ver.at[e_r].set(ver, mode="drop"),
+        valid=t.valid.at[e_r].set(True, mode="drop"),
     )
     safe_bloom = jnp.where(keep, bkt, t.n_buckets)
     new = new.replace(
         bloom_hi=new.bloom_hi.at[safe_bloom].set(bloom_hi, mode="drop"),
         bloom_lo=new.bloom_lo.at[safe_bloom].set(bloom_lo, mode="drop"),
     )
-    dirty = cache.dirty.at[safe_b, victim].set(False, mode="drop")
+    dirty = cache.dirty.at[e_r].set(False, mode="drop")
     return cache.replace(kv=new, dirty=dirty,
                          clock=cache.clock + U32(1)), evicted

@@ -8,12 +8,21 @@ sized to hold the whole keyspace in HBM, so the fast path always "hits"
 (capacity permitting); bucket overflow surfaces as a SPILL reply for a host
 overflow store instead of an eviction protocol.
 
-Layout (struct-of-arrays, S slots per bucket):
-  key_hi/key_lo  u32 [NB, S]   64-bit keys as uint32 pairs
-  val            u32 [NB, S, VW]
-  ver            u32 [NB, S]
-  valid          bool [NB, S]
-  bloom_hi/lo    u32 [NB]      64-bit per-bucket bloom (negative lookups)
+Layout (struct-of-arrays, S slots per bucket, ALL FLAT): entry
+e = bucket*S + slot indexes
+  key_hi/key_lo  u32 [NB*S]
+  val            u32 [NB*S*VW]  interleaved (entry e's words at [e*VW, (e+1)*VW))
+  ver            u32 [NB*S]
+  valid          bool [NB*S]
+  bloom_hi/lo    u32 [NB]       64-bit per-bucket bloom (negative lookups)
+
+Flat 1-D layouts are a measured v5e requirement, not a style choice: XLA
+tiles a trailing dim of S=4..16 (or VW=10) to 128 lanes, so the previous
+[NB, S] / [NB, S, VW] arrays cost 512 B per slot — the reference's
+24M-key store config (store/ebpf/utils.h:11-14) would need ~30 GB of HBM
+for ~1.3 GB of data, and 2-D-index scatters serialize where flat
+unique-index scatters do not (PERF.md; same finding that shaped
+engines/tatp_dense.py).
 
 The per-entry CAS `lock` word of the reference has no equivalent: intra-batch
 conflicts are resolved deterministically (ops.segments), so the table needs no
@@ -34,44 +43,66 @@ I32 = jnp.int32
 
 @flax.struct.dataclass
 class KVTable:
-    key_hi: jax.Array
-    key_lo: jax.Array
-    val: jax.Array
-    ver: jax.Array
-    valid: jax.Array
-    bloom_hi: jax.Array
-    bloom_lo: jax.Array
+    key_hi: jax.Array     # u32 [NB*S]
+    key_lo: jax.Array     # u32 [NB*S]
+    val: jax.Array        # u32 [NB*S*VW] interleaved
+    ver: jax.Array        # u32 [NB*S]
+    valid: jax.Array      # bool [NB*S]
+    bloom_hi: jax.Array   # u32 [NB]
+    bloom_lo: jax.Array   # u32 [NB]
+    slots: int = flax.struct.field(pytree_node=False, default=4)
+    val_words: int = flax.struct.field(pytree_node=False, default=10)
 
     @property
     def n_buckets(self):
-        return self.key_hi.shape[0]
+        return self.key_hi.shape[0] // self.slots
 
     @property
-    def slots(self):
-        return self.key_hi.shape[1]
-
-    @property
-    def val_words(self):
-        return self.val.shape[2]
+    def val2d(self):
+        """[NB*S, VW] view for host-side dumps (not the hot path)."""
+        return self.val.reshape(-1, self.val_words)
 
 
 def create(n_buckets: int, slots: int = 4, val_words: int = 10) -> KVTable:
     assert n_buckets & (n_buckets - 1) == 0
+    ne = n_buckets * slots
+    assert ne * val_words < (1 << 31), "entry*VW overflows i32 flat indices"
     return KVTable(
-        key_hi=jnp.zeros((n_buckets, slots), U32),
-        key_lo=jnp.zeros((n_buckets, slots), U32),
-        val=jnp.zeros((n_buckets, slots, val_words), U32),
-        ver=jnp.zeros((n_buckets, slots), U32),
-        valid=jnp.zeros((n_buckets, slots), bool),
+        key_hi=jnp.zeros((ne,), U32),
+        key_lo=jnp.zeros((ne,), U32),
+        val=jnp.zeros((ne * val_words,), U32),
+        ver=jnp.zeros((ne,), U32),
+        valid=jnp.zeros((ne,), bool),
         bloom_hi=jnp.zeros((n_buckets,), U32),
         bloom_lo=jnp.zeros((n_buckets,), U32),
+        slots=slots, val_words=val_words,
     )
 
 
+def bucket_rows(table: KVTable, bkt):
+    """Flat entry indices of each request's bucket row: [R, S]."""
+    s = table.slots
+    return bkt[:, None] * s + jnp.arange(s, dtype=I32)[None]
+
+
+def entry_val(table: KVTable, eidx):
+    """Gather entry values: eidx [R] -> [R, VW] (flat interleaved words)."""
+    vw = table.val_words
+    return table.val[eidx[:, None] * vw + jnp.arange(vw, dtype=I32)[None]]
+
+
+def val_word_idx(table: KVTable, eidx):
+    """Flat word indices [R*VW] for scattering whole entry values; pair
+    with values.reshape(-1). OOB entry indices propagate to OOB words."""
+    vw = table.val_words
+    return (eidx[:, None] * vw + jnp.arange(vw, dtype=I32)[None]).reshape(-1)
+
+
 def _match_bucket(table: KVTable, key_hi, key_lo, bkt):
-    rows_hi = table.key_hi[bkt]          # [R, S]
-    rows_lo = table.key_lo[bkt]
-    rows_valid = table.valid[bkt]
+    rows = bucket_rows(table, bkt)                    # [R, S]
+    rows_hi = table.key_hi[rows]
+    rows_lo = table.key_lo[rows]
+    rows_valid = table.valid[rows]
     match = rows_valid & (rows_hi == key_hi[:, None]) & (rows_lo == key_lo[:, None])
     free = (~rows_valid).sum(axis=-1).astype(I32)
     return match.any(axis=-1), jnp.argmax(match, axis=-1).astype(I32), free
@@ -91,8 +122,9 @@ def probe(table: KVTable, key_hi, key_lo, b1, b2):
     hit = hit1 | hit2
     bkt = jnp.where(hit1, b1, b2)
     slot = jnp.where(hit1, slot1, slot2)
-    val = table.val[bkt, slot]
-    ver = table.ver[bkt, slot]
+    eidx = bkt * table.slots + slot
+    val = entry_val(table, eidx)
+    ver = table.ver[eidx]
     return hit, bkt, slot, val, ver, free1, free2
 
 
@@ -128,9 +160,10 @@ def recompute_bloom(table: KVTable, bkt, write_mask):
     keys, and scatter back. Exact — unlike the reference, which can only OR
     bits in-kernel and recomputes in userspace on DELETE
     (tatp/ebpf/shard_user.c DELETE path)."""
-    rows_hi = table.key_hi[bkt]          # [R, S]
-    rows_lo = table.key_lo[bkt]
-    rows_valid = table.valid[bkt]
+    rows = bucket_rows(table, bkt)
+    rows_hi = table.key_hi[rows]
+    rows_lo = table.key_lo[rows]
+    rows_valid = table.valid[rows]
     bit = hashing.bloom_bit(rows_hi, rows_lo)         # [R, S]
     hi_bits = jnp.where(rows_valid & (bit >= 32),
                         U32(1) << jnp.clip(bit - 32, 0, 31).astype(U32), U32(0))
@@ -153,10 +186,10 @@ def recompute_bloom(table: KVTable, bkt, write_mask):
 def to_dict(table: KVTable) -> dict:
     """Dump live entries to {key: (val tuple, ver)} for differential tests."""
     valid = np.asarray(table.valid)
-    b, s = np.nonzero(valid)
-    keys = u64.join(np.asarray(table.key_hi)[b, s], np.asarray(table.key_lo)[b, s])
-    vals = np.asarray(table.val)[b, s]
-    vers = np.asarray(table.ver)[b, s]
+    e = np.nonzero(valid)[0]
+    keys = u64.join(np.asarray(table.key_hi)[e], np.asarray(table.key_lo)[e])
+    vals = np.asarray(table.val).reshape(-1, table.val_words)[e]
+    vers = np.asarray(table.ver)[e]
     return {int(k): (tuple(int(x) for x in v), int(ver))
             for k, v, ver in zip(keys, vals, vers)}
 
@@ -219,7 +252,8 @@ def populate(table: KVTable, keys: np.ndarray, vals: np.ndarray,
     keyspace (the reference instead sizes ad hoc, e.g. SAV_HASH_SIZE =
     ACCOUNT_NUM*3/2/4, smallbank/ebpf/utils.h:16-17, and relies on chaining).
     """
-    nb, s = table.key_hi.shape
+    nb, s = table.n_buckets, table.slots
+    ne = nb * s
     keys = np.asarray(keys, np.uint64)
     if len(np.unique(keys)) != len(keys):
         raise ValueError("duplicate keys in populate")
@@ -227,23 +261,25 @@ def populate(table: KVTable, keys: np.ndarray, vals: np.ndarray,
     if vers is None:
         vers = np.ones(len(keys), np.uint32)
     bkt, slot = assign_two_choice(keys, nb, s)
+    eidx = bkt * s + slot
 
     k_hi, k_lo = u64.split(keys)
-    key_hi = np.zeros((nb, s), np.uint32)
-    key_lo = np.zeros((nb, s), np.uint32)
-    val = np.zeros((nb, s, table.val_words), np.uint32)
-    ver = np.zeros((nb, s), np.uint32)
-    valid = np.zeros((nb, s), bool)
-    key_hi[bkt, slot] = k_hi
-    key_lo[bkt, slot] = k_lo
-    val[bkt, slot] = vals
-    ver[bkt, slot] = vers
-    valid[bkt, slot] = True
+    key_hi = np.zeros(ne, np.uint32)
+    key_lo = np.zeros(ne, np.uint32)
+    val = np.zeros((ne, table.val_words), np.uint32)
+    ver = np.zeros(ne, np.uint32)
+    valid = np.zeros(ne, bool)
+    key_hi[eidx] = k_hi
+    key_lo[eidx] = k_lo
+    val[eidx] = vals
+    ver[eidx] = vers
+    valid[eidx] = True
     bits = hashing.bloom_bit_np(keys)
     bloom = np.zeros(nb, np.uint64)
     np.bitwise_or.at(bloom, bkt, np.uint64(1) << bits.astype(np.uint64))
     b_hi, b_lo = u64.split(bloom)
-    return KVTable(key_hi=jnp.asarray(key_hi), key_lo=jnp.asarray(key_lo),
-                   val=jnp.asarray(val), ver=jnp.asarray(ver),
-                   valid=jnp.asarray(valid),
-                   bloom_hi=jnp.asarray(b_hi), bloom_lo=jnp.asarray(b_lo))
+    return table.replace(
+        key_hi=jnp.asarray(key_hi), key_lo=jnp.asarray(key_lo),
+        val=jnp.asarray(val.reshape(-1)), ver=jnp.asarray(ver),
+        valid=jnp.asarray(valid),
+        bloom_hi=jnp.asarray(b_hi), bloom_lo=jnp.asarray(b_lo))

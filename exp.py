@@ -702,7 +702,9 @@ def run_all(out: str, window_s: float = 10.0, quick: bool = False,
         "DINT_EXP_SUBSCRIBERS", 7_000_000))
     n_acc = 20_000 if quick else int(os.environ.get(
         "DINT_EXP_SB_ACCOUNTS", 24_000_000))
-    widths = [256] if quick else [256, 1024, 2048, 8192, 32768]
+    # peak width first: a flaky tunnel window should yield the
+    # highest-value anchor point before the latency-floor small widths
+    widths = [256] if quick else [8192, 256, 1024, 2048, 32768]
     cpb = 4
     rates = OPEN_RATES[1::2] if quick else OPEN_RATES
 
