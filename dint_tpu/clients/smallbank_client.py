@@ -46,10 +46,16 @@ def init_shards(n_accounts: int, init_balance: int = 1000):
     shards = []
     for _ in range(N_SHARDS):
         s = smallbank.create(n_accounts, val_words=VW)
-        s = s.replace(sav=type(s.sav)(val=jax.numpy.asarray(vals),
-                                      ver=jax.numpy.ones(n_accounts, jax.numpy.uint32)),
-                      chk=type(s.chk)(val=jax.numpy.asarray(vals),
-                                      ver=jax.numpy.ones(n_accounts, jax.numpy.uint32)))
+        # fresh buffers per field: steps donate their state, so sav/chk
+        # (and each replica) must not alias one device array — same rule
+        # as tatp_client.populate_shards
+        s = s.replace(
+            sav=s.sav.replace(val=jax.numpy.asarray(vals.reshape(-1)),
+                              ver=jax.numpy.ones(n_accounts,
+                                                 jax.numpy.uint32)),
+            chk=s.chk.replace(val=jax.numpy.asarray(vals.reshape(-1)),
+                              ver=jax.numpy.ones(n_accounts,
+                                                 jax.numpy.uint32)))
         shards.append(s)
     return shards
 
@@ -227,6 +233,8 @@ class Coordinator:
 def total_balance(shards) -> int:
     """Sum of all balances on a replica (invariant checking)."""
     s = shards[0]
-    sav = np.asarray(s.sav.val)[:, 0].view(np.int32).astype(np.int64).sum()
-    chk = np.asarray(s.chk.val)[:, 0].view(np.int32).astype(np.int64).sum()
+    sav = np.asarray(s.sav.val)[0::s.sav.val_words] \
+        .view(np.int32).astype(np.int64).sum()
+    chk = np.asarray(s.chk.val)[0::s.chk.val_words] \
+        .view(np.int32).astype(np.int64).sum()
     return int(sav + chk)

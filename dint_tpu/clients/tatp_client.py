@@ -92,13 +92,13 @@ def populate_shards(rng: np.random.Generator, n_subscribers: int,
     for _ in range(N_SHARDS):
         s = tatp.create(n_subscribers, val_words=val_words, **kw)
         s = s.replace(
-            sub=s.sub.replace(val=jax.numpy.asarray(sub_vals),
+            sub=s.sub.replace(val=jax.numpy.asarray(sub_vals.reshape(-1)),
                               ver=jax.numpy.asarray(ver1)),
-            sec=s.sec.replace(val=jax.numpy.asarray(sub_vals),
+            sec=s.sec.replace(val=jax.numpy.asarray(sub_vals.reshape(-1)),
                               ver=jax.numpy.asarray(ver1)),
-            ai=s.ai.replace(val=jax.numpy.asarray(ai_vals),
+            ai=s.ai.replace(val=jax.numpy.asarray(ai_vals.reshape(-1)),
                             ver=jax.numpy.asarray(ai_ver)),
-            sf=s.sf.replace(val=jax.numpy.asarray(sf_vals),
+            sf=s.sf.replace(val=jax.numpy.asarray(sf_vals.reshape(-1)),
                             ver=jax.numpy.asarray(sf_ver)),
             cf=cf_table,
         )

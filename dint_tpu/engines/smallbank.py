@@ -68,7 +68,8 @@ def create(n_accounts: int, val_words: int = 2, log_lanes: int = 16,
 def _gather(shard: Shard, is_chk, acct):
     sh0 = jnp.where(is_chk, shard.chk_sh[acct], shard.sav_sh[acct])
     ex0 = jnp.where(is_chk, shard.chk_ex[acct], shard.sav_ex[acct])
-    val0 = jnp.where(is_chk[:, None], shard.chk.val[acct], shard.sav.val[acct])
+    val0 = jnp.where(is_chk[:, None], dense.gather_rows(shard.chk, acct),
+                     dense.gather_rows(shard.sav, acct))
     ver0 = jnp.where(is_chk, shard.chk.ver[acct], shard.sav.ver[acct])
     return sh0, ex0, val0, ver0
 
@@ -137,10 +138,10 @@ def step(shard: Shard, batch: Batch):
         chk_sh=segments.scatter_rows(shard.chk_sh, acct, new_sh, w_chk),
         chk_ex=segments.scatter_rows(shard.chk_ex, acct, new_ex, w_chk),
         sav=shard.sav.replace(
-            val=segments.scatter_rows(shard.sav.val, acct, val1, v_sav),
+            val=dense.scatter_rows_val(shard.sav, acct, val1, v_sav),
             ver=segments.scatter_rows(shard.sav.ver, acct, ver1, v_sav)),
         chk=shard.chk.replace(
-            val=segments.scatter_rows(shard.chk.val, acct, val1, v_chk),
+            val=dense.scatter_rows_val(shard.chk, acct, val1, v_chk),
             ver=segments.scatter_rows(shard.chk.ver, acct, ver1, v_chk)),
     )
 

@@ -80,6 +80,7 @@ def create_stacked(n_accounts: int, init_balance: int = 1000) -> smallbank.Shard
         val = jnp.zeros((n_accounts, VW), U32)
         val = val.at[:, 0].set(U32(init_balance))
         val = val.at[:, 1].set(U32(MAGIC))
+        val = val.reshape(-1)            # flat interleaved (tables.dense)
         ver = jnp.ones((n_accounts,), U32)
         return s.replace(sav=s.sav.replace(val=val, ver=ver),
                          chk=s.chk.replace(val=val, ver=ver))
@@ -93,8 +94,9 @@ def total_balance(stacked: smallbank.Shard, replica: int = 0):
     """Device-side balance sum over one replica, wrapping mod 2^32 (x64 is
     off, so i32 accumulate; conservation checks must compare DELTAS under
     the same wraparound — exact because two's-complement add is associative)."""
-    sav = stacked.sav.val[replica, :, 0].astype(I32)
-    chk = stacked.chk.val[replica, :, 0].astype(I32)
+    vw = stacked.sav.val_words
+    sav = stacked.sav.val[replica, 0::vw].astype(I32)   # word0 = balance
+    chk = stacked.chk.val[replica, 0::vw].astype(I32)
     return sav.sum(dtype=I32) + chk.sum(dtype=I32)
 
 

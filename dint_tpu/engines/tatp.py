@@ -109,7 +109,7 @@ def _dense_gather(shard: Shard, tbl, idx):
     """Gather (val, ver, locked) for dense tables 0..3, OOB-safe."""
     def pick(t: dense.DenseTable, lock, n):
         i = jnp.clip(idx, 0, n - 1)
-        return t.val[i], t.ver[i], lock[i]
+        return dense.gather_rows(t, i), t.ver[i], lock[i]
 
     v0, r0, l0 = pick(shard.sub, shard.sub_lock, shard.sub.size)
     v1, r1, l1 = pick(shard.sec, shard.sec_lock, shard.sec.size)
@@ -176,7 +176,7 @@ def _dense_step(shard: Shard, batch: Batch):
         m = writer & (tbl == which)
         i = jnp.clip(idx, 0, n - 1)
         return t.replace(
-            val=segments.scatter_rows(t.val, i, val1, m),
+            val=dense.scatter_rows_val(t, i, val1, m),
             ver=segments.scatter_rows(t.ver, i, ver1, m),
         ), segments.scatter_rows(lock, i, new_locked, m)
 

@@ -7,6 +7,12 @@ tatp/caladan/tatp.h:28) are dense integers. On TPU, dense keys index HBM
 arrays directly — no probe, no buckets, no collisions, and per-record locks
 become exact instead of hash-conflated. Sparse/composite-key tables
 (e.g. TATP CALL_FORWARDING) still use tables.kv.KVTable.
+
+``val`` is a tight interleaved 1-D word array (row r's words at
+[r*VW, (r+1)*VW)) — a [N, VW] array would be XLA-tiled to 128 lanes
+(512 B/row at VW=10), which caps the generic engines ~40x below the
+reference's keyspace sizes on a 16 GB chip (same measured finding as
+tables/kv.py and engines/tatp_dense.py).
 """
 from __future__ import annotations
 
@@ -21,26 +27,45 @@ U32 = jnp.uint32
 
 @flax.struct.dataclass
 class DenseTable:
-    val: jax.Array   # u32 [N, VW]
+    val: jax.Array   # u32 [N * VW] interleaved
     ver: jax.Array   # u32 [N]
+    val_words: int = flax.struct.field(pytree_node=False, default=10)
 
     @property
     def size(self):
         return self.ver.shape[0]
 
-    @property
-    def val_words(self):
-        return self.val.shape[1]
-
 
 def create(n: int, val_words: int) -> DenseTable:
-    return DenseTable(val=jnp.zeros((n, val_words), U32),
-                      ver=jnp.zeros((n,), U32))
+    assert n * val_words < (1 << 31), "row*VW overflows i32 flat indices"
+    return DenseTable(val=jnp.zeros((n * val_words,), U32),
+                      ver=jnp.zeros((n,), U32), val_words=val_words)
+
+
+def row_word_idx(idx, val_words: int):
+    """Flat word indices [R, VW] of rows [R] in an interleaved value array
+    (shared by tables.kv's entry gathers — one implementation of the
+    row*VW+j math)."""
+    return idx[:, None] * val_words + jnp.arange(val_words, dtype=I32)[None]
+
+
+def gather_rows(table: DenseTable, idx):
+    """Row gather: idx [R] -> values [R, VW]."""
+    return table.val[row_word_idx(idx, table.val_words)]
+
+
+def scatter_rows_val(table: DenseTable, idx, values, mask):
+    """Masked row scatter; returns the new flat val array (masked lanes
+    drop out of bounds)."""
+    safe = jnp.where(mask, idx, table.size)
+    flat = row_word_idx(safe, table.val_words).reshape(-1)
+    return table.val.at[flat].set(values.reshape(-1), mode="drop")
 
 
 def populate(table: DenseTable, vals: np.ndarray, vers=None) -> DenseTable:
     vals = np.asarray(vals, np.uint32)
-    assert vals.shape == table.val.shape
+    assert vals.shape == (table.size, table.val_words)
     if vers is None:
         vers = np.ones(table.size, np.uint32)
-    return DenseTable(val=jnp.asarray(vals), ver=jnp.asarray(vers))
+    return table.replace(val=jnp.asarray(vals.reshape(-1)),
+                         ver=jnp.asarray(vers))

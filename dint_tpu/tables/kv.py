@@ -37,6 +37,7 @@ import numpy as np
 
 from ..ops import hashing, segments, u64
 from ..ops.u64 import U32
+from . import dense
 
 I32 = jnp.int32
 
@@ -87,15 +88,13 @@ def bucket_rows(table: KVTable, bkt):
 
 def entry_val(table: KVTable, eidx):
     """Gather entry values: eidx [R] -> [R, VW] (flat interleaved words)."""
-    vw = table.val_words
-    return table.val[eidx[:, None] * vw + jnp.arange(vw, dtype=I32)[None]]
+    return table.val[dense.row_word_idx(eidx, table.val_words)]
 
 
 def val_word_idx(table: KVTable, eidx):
     """Flat word indices [R*VW] for scattering whole entry values; pair
     with values.reshape(-1). OOB entry indices propagate to OOB words."""
-    vw = table.val_words
-    return (eidx[:, None] * vw + jnp.arange(vw, dtype=I32)[None]).reshape(-1)
+    return dense.row_word_idx(eidx, table.val_words).reshape(-1)
 
 
 def _match_bucket(table: KVTable, key_hi, key_lo, bkt):

@@ -41,7 +41,8 @@ def test_replicated_step_8dev(rng):
     assert int(committed[0]) == m  # psum'd vote count, same on every device
 
     # pull state host-side and check primary + both replicas of each key
-    sub_val = np.asarray(jax.device_get(state.sub.val))  # [n, rows, VW]
+    sub_val = np.asarray(jax.device_get(state.sub.val))
+    sub_val = sub_val.reshape(sub_val.shape[0], -1, VW)  # [n, rows, VW]
     sub_ver = np.asarray(jax.device_get(state.sub.ver))
     for k in keys:
         own = int(k % n)
@@ -120,7 +121,8 @@ def test_sharded_smallbank_8dev(rng):
     state, replies, committed = step(state, waves[0])
     assert int(committed[0]) == m
 
-    sav_val = np.asarray(jax.device_get(state.sav.val))  # [n, rows, 2]
+    sav_val = np.asarray(jax.device_get(state.sav.val))
+    sav_val = sav_val.reshape(sav_val.shape[0], -1, 2)  # [n, rows, 2]
     for a in accts:
         own = int(a % n)
         for role in range(3):

@@ -20,9 +20,9 @@ def test_fused_lock_read_and_commit():
     vals[:, 0] = 50
     vals[:, 1] = wl.SB_MAGIC
     shard = shard.replace(
-        sav=shard.sav.replace(val=jax.numpy.asarray(vals),
+        sav=shard.sav.replace(val=jax.numpy.asarray(vals.reshape(-1)),
                               ver=jax.numpy.ones(100, jax.numpy.uint32)),
-        chk=shard.chk.replace(val=jax.numpy.asarray(vals),
+        chk=shard.chk.replace(val=jax.numpy.asarray(vals.reshape(-1)),
                               ver=jax.numpy.ones(100, jax.numpy.uint32)))
     step = jax.jit(smallbank.step)
 
