@@ -58,7 +58,10 @@ WINDOW_S = float(os.environ.get("DINT_BENCH_WINDOW_S", 10.0))
 
 ATTEMPTS = 6              # observed axon outages last tens of minutes;
 BACKOFF_S = 120.0         # backoff*attempt: 30 min of patience total
-CHILD_TIMEOUT_S = 540.0   # populate + first jit compile can take minutes
+# 7M-subscriber populate + 2 pipeline compiles + window + the two-width
+# SmallBank leg (24M create + 2 compiles + 2 windows) over a slow tunnel;
+# a mid-leg timeout still salvages the already-printed headline line
+CHILD_TIMEOUT_S = 900.0
 PROBE_TIMEOUT_S = 90.0
 
 
