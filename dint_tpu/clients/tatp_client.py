@@ -40,6 +40,8 @@ class Stats(stats.TxnStats):
     aborted_lock: int = 0      # write-set lock rejected
     aborted_validate: int = 0  # read-set version changed
     aborted_missing: int = 0   # required row absent / insert-exists
+    aborted_timeout: int = 0   # wire transport exhausted resends (incl.
+    timeout_lanes: int = 0     # in-doubt commits); lanes = raw datagram count
     # lock-attribution counters (live when the shards were built with
     # tatp.create(attr_locks=True); the reference's instrumented client
     # keeps the same three, tatp/caladan/client_lock.cc:62-64,768-771)
@@ -253,9 +255,15 @@ class Coordinator:
         is_ro = (t == wl.TATP_GET_SUBSCRIBER) | (t == wl.TATP_GET_ACCESS) | \
                 (t == wl.TATP_GET_NEW_DEST)
         rw = ~is_ro
-        alive = rw & ~lock_rejected & ~missing
-        st.aborted_lock += int((rw & lock_rejected).sum())
-        st.aborted_missing += int((missing & ~(rw & lock_rejected)).sum())
+        # transport timeouts (wire coordinator only; the in-process path
+        # never produces Reply.TIMEOUT) classify FIRST: a lane whose reply
+        # never arrived says nothing about locks or row existence
+        timed = (r_rt == Reply.TIMEOUT).any(1)
+        st.aborted_timeout += int(timed.sum())
+        alive = rw & ~lock_rejected & ~missing & ~timed
+        st.aborted_lock += int((rw & lock_rejected & ~timed).sum())
+        st.aborted_missing += int(
+            (missing & ~(rw & lock_rejected) & ~timed).sum())
 
         # ---- wave 2: validate read-set (re-read, compare versions) ---------
         # read-set lanes are the OCC_READ lanes of alive RW txns
@@ -273,8 +281,11 @@ class Coordinator:
                   ((vt != Reply.VAL) & (r_rt[v_txn, v_lane] == Reply.VAL))
             # for InsertCF the cf read was NOT_EXIST; it must STILL not exist
             np.logical_or.at(changed, v_txn, bad)
-            st.aborted_validate += int((alive & changed).sum())
-            alive = alive & ~changed
+            tmo2 = np.zeros(w, bool)   # lost validate reply != version change
+            np.logical_or.at(tmo2, v_txn, vt == Reply.TIMEOUT)
+            st.aborted_timeout += int((alive & tmo2).sum())
+            st.aborted_validate += int((alive & changed & ~tmo2).sum())
+            alive = alive & ~changed & ~tmo2
 
         # ---- commit waves --------------------------------------------------
         # write-set per txn: (table, key, newval, kind) kind: 0=commit 1=insert 2=delete
@@ -295,7 +306,7 @@ class Coordinator:
         add_writes(alive & (t == wl.TATP_INSERT_CF), T.CALL_FORWARDING, cfk, 1)
         add_writes(alive & (t == wl.TATP_DELETE_CF), T.CALL_FORWARDING, cfk, 2)
 
-        if w_tb:
+        if w_tb and sum(len(x) for x in w_tb):
             c_tb = np.concatenate(w_tb).astype(np.int32)
             c_key = np.concatenate(w_key).astype(np.int64)
             c_kind = np.concatenate(w_kind).astype(np.int32)
@@ -312,6 +323,14 @@ class Coordinator:
             pr = np.vectorize(wr_ops.get)(c_kind).astype(np.int32)
             prt, _, _ = self._run_wave(pr, c_tb, c_key, prim, c_val)
             assert (prt != Reply.NONE).all()
+            # a lost CommitPrim reply leaves the txn in doubt (the primary
+            # may or may not have installed); count it as a timeout abort —
+            # conservative vs the reference, which resends until acked
+            in_doubt = np.zeros(w, bool)
+            np.logical_or.at(in_doubt, np.concatenate(w_txn),
+                             prt == Reply.TIMEOUT)
+            st.aborted_timeout += int((alive & in_doubt).sum())
+            alive = alive & ~in_doubt
 
         # ---- abort unlocks: granted locks of dead RW txns -------------------
         dead = rw & ~alive

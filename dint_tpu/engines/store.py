@@ -138,6 +138,15 @@ def step(table: kv.KVTable, batch: Batch, *, maintain_bloom: bool = False):
     rver = jnp.where(seg_spill & is_install, U32(0), rver)
 
     # ---- scatters (flat 1-D unique-index: one writer per entry) ----------
+    # NOTE on unique_indices=True + the OOB sentinel: every MASKED lane is
+    # routed to the same out-of-bounds index (ne), so indices are only
+    # unique among the lanes that actually write — duplicated OOB lanes
+    # technically violate JAX's uniqueness contract (documented UB). We
+    # rely on mode="drop" discarding OOB lanes before any dedup matters;
+    # tests/test_ops.py::test_oob_dup_scatter_unique_indices pins this
+    # lowering behavior so a jaxlib upgrade that changes it fails loudly
+    # instead of corrupting tables. (Same pattern: tatp_dense.pipe_step
+    # wflat / populate_device idx, smallbank_dense scatters.)
     ne = table.n_buckets * table.slots
     s = table.slots
     w_any_slot = o_upd | ok | o_del

@@ -262,29 +262,38 @@ def populate_device(key, n_sub: int, val_words: int = 10, **kw) -> DenseDB:
 
     @jax.jit
     def build(key):
+        # every draw/temp here is deliberately 1-D: a (p1, 4) or (p1, 4, 3)
+        # draw pads its minor dim up to 128 lanes under TPU tiling — at
+        # p1=7e6 the (p1,4,3) bernoulli padded 42.7x to 13.35 GB and OOMed
+        # the 16 GB chip AT COMPILE TIME (measured, round 5). Flat layouts
+        # pad 1.0x; per-subscriber reductions use strided slices instead
+        # of a trailing axis.
         k_ai, k_sf, k_cf = jax.random.split(key, 3)
         sub_e = jnp.arange(p1, dtype=I32) >= 1                  # [p1]
 
         def present(k):
-            pr = jax.random.bernoulli(k, 0.625, (p1, 4))
-            pr = pr.at[:, 0].set(pr[:, 0] | ~pr.any(axis=1))    # >=1 each
-            return pr & sub_e[:, None]
+            pr = jax.random.bernoulli(k, 0.625, (p1 * 4,))      # idx=s*4+t
+            any4 = pr[0::4] | pr[1::4] | pr[2::4] | pr[3::4]
+            pr = pr.at[0::4].set(pr[0::4] | ~any4)              # >=1 each
+            # s = idx//4: 1-D gather instead of a [p1,4] broadcast
+            return pr & sub_e[jnp.arange(p1 * 4, dtype=I32) // 4]
 
-        ai_p = present(k_ai)
+        ai_p = present(k_ai)                                    # [4*p1]
         sf_p = present(k_sf)
-        # cf rows: [p1, 4 sf_types, 3 start_times]; flat index IS cf_key =
-        # s*12 + (sf_type-1)*3 + start_time/8 (tatp.cf_key)
-        cf_p = sf_p[:, :, None] & jax.random.bernoulli(k_cf, 0.25,
-                                                       (p1, 4, 3))
+        # cf rows flat [12*p1]: idx = s*12 + (sf_type-1)*3 + start_time/8,
+        # exactly tatp.cf_key's layout; idx//3 is the covering sf element
+        cf_p = sf_p[jnp.arange(p1 * 12, dtype=I32) // 3] \
+            & jax.random.bernoulli(k_cf, 0.25, (p1 * 12,))
         exists = jnp.concatenate([
-            sub_e, sub_e, ai_p.reshape(-1), sf_p.reshape(-1),
-            cf_p.reshape(-1), jnp.zeros((1,), bool)])           # [n1]
+            sub_e, sub_e, ai_p, sf_p, cf_p,
+            jnp.zeros((1,), bool)])                             # [n1]
         meta = jnp.where(exists, U32((1 << 1) | 1), U32(0))
 
-        # payload = index within the row's table region (populate's `put`)
+        # payload = index within the row's table region (populate's `put`);
+        # 5 scalar compares instead of searchsorted's vmapped while loop
         rows = jnp.arange(n1, dtype=I32)
-        region = jnp.searchsorted(base, rows, side="right") - 1
-        payload = (rows - base[jnp.clip(region, 0, 4)]).astype(U32)
+        region = sum((rows >= base[i]).astype(I32) for i in range(1, 5))
+        payload = (rows - base[region]).astype(U32)
         val = jnp.zeros((n1 * val_words,), U32)
         idx = jnp.where(exists, rows, n1) * val_words   # absent -> dropped
         val = val.at[idx].set(payload, mode="drop", unique_indices=True)

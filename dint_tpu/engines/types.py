@@ -74,6 +74,12 @@ class Reply:
     REJECT_SAME_KEY = 8  # lock-attribution variant: holder has the SAME key
                          # (true conflict, not hash sharing) — the reference's
                          # REJECT_LOCK_SAME_KEY (tatp/ebpf/lock_kern.c:292-298)
+    TIMEOUT = 9        # transport-level sentinel: the wire client exhausted
+                       # its resend budget for this lane. Never emitted by an
+                       # engine; the reference client resends forever
+                       # (client_ebpf_shard.cc:643-677) so loss shows up as
+                       # latency — here a capped retry loop surfaces it as an
+                       # ab_timeout txn instead of voiding the whole run
 
 
 @flax.struct.dataclass

@@ -168,3 +168,29 @@ def test_mix_thresholds_normalizes_raw_weights():
                    len(frac) - 1)
     got = np.bincount(t, minlength=len(frac)) / len(words)
     assert np.abs(got - np.asarray(wl.TATP_MIX)).max() < 0.005
+
+
+def test_oob_dup_scatter_unique_indices():
+    """Pin the lowering behavior every engine's masked scatter relies on:
+    all masked lanes share ONE out-of-bounds sentinel index under
+    unique_indices=True + mode="drop" (see engines/store.py scatter note).
+    Duplicated OOB indices are technically outside JAX's uniqueness
+    contract; if a jaxlib upgrade changes how drop interacts with dedup,
+    this must fail before the differential tests see corrupted tables."""
+    import jax
+    import jax.numpy as jnp
+
+    n = 16
+
+    @jax.jit
+    def scatter(arr, idx, val):
+        return arr.at[idx].set(val, mode="drop", unique_indices=True)
+
+    arr = jnp.zeros(n, jnp.uint32)
+    # 2 real writers, 6 masked lanes all routed to the OOB sentinel n
+    idx = jnp.asarray([3, n, n, 7, n, n, n, n], jnp.int32)
+    val = jnp.arange(1, 9, dtype=jnp.uint32)
+    out = np.asarray(scatter(arr, idx, val))
+    expect = np.zeros(n, np.uint32)
+    expect[3], expect[7] = 1, 4
+    assert np.array_equal(out, expect)

@@ -304,7 +304,39 @@ def test_tatp_full_transactions_over_wire():
             assert st.committed > 0
             # outcome taxonomy closes
             assert (st.committed + st.aborted_lock + st.aborted_validate
-                    + st.aborted_missing) == st.attempted
+                    + st.aborted_missing + st.aborted_timeout) \
+                == st.attempted
+            assert st.timeout_lanes == 0    # loopback: no loss
             # population-driven miss floor is ~25% of the mix; leave slack
             # for the tiny keyspace's contention
             assert st.committed > st.attempted * 0.45
+
+
+def test_tatp_wire_timeout_counts_not_raises():
+    """A lossy/dead server must yield a NUMBER plus a timeout count, not a
+    voided run (round-4 verdict: the reference client retries forever so
+    loss shows up as latency; our capped retry budget surfaces it as
+    ab_timeout txns instead of raising away the whole bench point)."""
+    import socket
+
+    from dint_tpu.clients import tatp_wire as tw
+
+    # 3 bound-but-never-served ports: every datagram vanishes
+    socks = [socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+             for _ in range(3)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    try:
+        with tw.WireCoordinator(ports, 200, width=256, timeout_ms=50,
+                                max_tries=2) as coord:
+            st = coord.run_cohort(np.random.default_rng(0), 32)
+            assert st.attempted == 32
+            assert st.committed == 0
+            assert st.aborted_timeout == 32       # every txn classified
+            assert st.timeout_lanes > 0           # raw datagram count too
+            assert (st.aborted_lock + st.aborted_validate
+                    + st.aborted_missing) == 0    # no misclassification
+    finally:
+        for s in socks:
+            s.close()
