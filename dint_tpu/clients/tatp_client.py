@@ -340,5 +340,5 @@ class Coordinator:
             self._run_wave(np.full(a_used.sum(), Op.ABORT, np.int32),
                            tbl.reshape(-1)[a_used], key.reshape(-1)[a_used])
 
-        st.committed += int((is_ro & ~missing).sum() + alive.sum())
+        st.committed += int((is_ro & ~missing & ~timed).sum() + alive.sum())
         return st
