@@ -194,6 +194,51 @@ def cohort_latency_percentiles(block_s, cohorts_per_block: int, depth: int):
     return out
 
 
+def run_latency_window(runner, state, key, window_s: float, n_stats: int,
+                       depth: int, warmup_blocks: int = 2):
+    """Latency-mode window: MEASURED per-cohort latency from real
+    timestamps instead of the block-time model.
+
+    Built for runners with cohorts_per_block == 1: every call dispatches
+    one pipeline step and its stats are fetched SYNCHRONOUSLY, so the
+    cohort dispatched at call j completes during call j+depth-1 (its
+    wave-1 step plus depth-1 further steps) and its latency is the
+    wall-clock difference t_end[j+depth-1] - t_start[j] — an actual
+    measurement spanning real device execution, the batched analogue of
+    the reference's every-txn microtime() sampling
+    (store/caladan/stat.h:15-20). The per-step sync fetch costs
+    throughput relative to run_window's overlapped dispatch — that is the
+    latency/throughput trade a latency-mode run exists to expose.
+
+    Returns (state, total, dt, steps, percentiles dict with ``n`` =
+    cohort sample count)."""
+    import jax
+
+    for i in range(warmup_blocks):
+        state, stats = runner(state, jax.random.fold_in(key, 10**6 + i))
+        np.asarray(stats)   # fetch = sync
+
+    total = np.zeros(n_stats, np.int64)
+    t_start, t_end = [], []
+    t0 = time.time()
+    i = 0
+    while time.time() - t0 < window_s:
+        t_start.append(time.time())
+        state, stats = runner(state, jax.random.fold_in(key, i))
+        total += np.asarray(stats, np.int64).sum(axis=0)    # sync fetch
+        t_end.append(time.time())
+        i += 1
+    dt = time.time() - t0
+    lat = LatencyReservoir()
+    if i > depth:
+        samples = (np.asarray(t_end[depth - 1:]) -
+                   np.asarray(t_start[: i - depth + 1])) * 1e6
+        lat.add(samples)
+    out = lat.percentiles()
+    out["n"] = lat.n_seen
+    return state, total, dt, i, out
+
+
 def run_window(runner, state, key, window_s: float, n_stats: int,
                warmup_blocks: int = 1):
     """Timed measurement loop shared by the device-fused pipeline benches.

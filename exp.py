@@ -215,9 +215,12 @@ def _metric_json(att, com, dt, p, extra):
 
 
 def sweep_pipeline(name, runner_fn, extras_fn, n_stats, *, widths, cpb,
-                   depth, magic_idx, window_s, open_rates, results):
+                   depth, magic_idx, window_s, open_rates, results,
+                   lat_widths=()):
     """Closed-loop width sweep, then open-loop rate sweep at the widest
-    width relative to its measured peak."""
+    width relative to its measured peak, then latency-mode points
+    (cohorts_per_block=1, per-step sync fetch) whose percentiles come
+    from MEASURED timestamps rather than the block-time model."""
     peak = None
     peak_w = None
 
@@ -261,6 +264,28 @@ def sweep_pipeline(name, runner_fn, extras_fn, n_stats, *, widths, cpb,
     for frac in open_rates:
         run_point(results, f"{name}_open_{int(frac * 100)}pct",
                   open_point(frac))
+
+    def latency_point(w):
+        def fn():
+            import jax
+
+            from dint_tpu import stats as st
+
+            run, carry, drain = runner_fn(w, 1)   # one cohort per dispatch
+            carry, total, dt, steps, p = st.run_latency_window(
+                run, carry, jax.random.PRNGKey(7), window_s, n_stats,
+                depth=depth)
+            _, tail = drain(carry)
+            total = total + np.asarray(tail, np.int64).sum(axis=0)
+            att, com, extra = extras_fn(total)
+            extra.update(mode="latency_measured", width=w, cpb=1,
+                         steps=steps, lat_samples=int(p["n"]))
+            return _metric_json(att, com, dt, p, extra)
+
+        return fn
+
+    for w in lat_widths:
+        run_point(results, f"{name}_latency_w{w}", latency_point(w))
 
 
 def _timed_client(client, go, window_s):
@@ -705,6 +730,9 @@ def run_all(out: str, window_s: float = 10.0, quick: bool = False,
     # peak width first: a flaky tunnel window should yield the
     # highest-value anchor point before the latency-floor small widths
     widths = [256] if quick else [8192, 256, 1024, 2048, 32768]
+    # measured-timestamp latency points (run_latency_window): small widths
+    # where the per-step sync fetch does not dominate the step itself
+    lat_widths = [256] if quick else [256, 1024, 8192]
     cpb = 4
     rates = OPEN_RATES[1::2] if quick else OPEN_RATES
 
@@ -720,14 +748,16 @@ def run_all(out: str, window_s: float = 10.0, quick: bool = False,
         sweep_pipeline("tatp", lambda w, b: _tatp_runner(n_sub, w, b),
                        _tatp_extras, td.N_STATS, widths=widths, cpb=cpb,
                        depth=3, magic_idx=td.STAT_MAGIC_BAD,
-                       window_s=window_s, open_rates=rates, results=results)
+                       window_s=window_s, open_rates=rates, results=results,
+                       lat_widths=lat_widths)
     if want("smallbank"):
         from dint_tpu.engines import smallbank_dense as sd
 
         sweep_pipeline("smallbank", lambda w, b: _sb_runner(n_acc, w, b),
                        _sb_extras, sd.N_STATS, widths=widths, cpb=cpb,
                        depth=2, magic_idx=sd.STAT_MAGIC_BAD,
-                       window_s=window_s, open_rates=rates, results=results)
+                       window_s=window_s, open_rates=rates, results=results,
+                       lat_widths=lat_widths)
     sweep_micro(window_s, quick, results, want=want)  # self-gates per point
 
     summary = {"configs": sorted(results),
@@ -750,8 +780,11 @@ def main():
                       only=args.only)
     for name in sorted(results):
         r = results[name]
-        print(f"{name}: goodput={r['goodput']:.0f}/s "
-              f"abort={r['abort_rate']:.4f} p99={r['p99_us']:.0f}us")
+        if "error" in r:
+            print(f"{name}: ERROR {r['error'][:120]}")
+        else:
+            print(f"{name}: goodput={r['goodput']:.0f}/s "
+                  f"abort={r['abort_rate']:.4f} p99={r['p99_us']:.0f}us")
 
 
 if __name__ == "__main__":
