@@ -1,0 +1,132 @@
+"""Bench-scale recovery artifact: kill a replica, rebuild, prove equality.
+
+Round-4 verdict weak-point 5: recovery existed only in toy unit runs
+(default log_capacity wraps within ~1 s at bench throughput and
+recover_* refuses wrapped rings). This tool runs a REAL measurement
+window at bench width with a ring sized from the measured append rate,
+then simulates the reference's failure story end-to-end:
+
+  1. populate TATP, snapshot the base state (the reference's populate
+     step, tatp/caladan/client_ebpf_shard.cc:96-341);
+  2. run a timed window of the fused pipeline at bench width — every
+     certified write is WAL'd to 3 replica log rings BEFORE install
+     (CommitLog x3, client_ebpf_shard.cc:779-810);
+  3. "kill" the device: discard its live tables, keeping only the base
+     snapshot + ONE surviving replica's log ring;
+  4. rebuild via recovery.recover_tatp_dense and verify val/ver/exists
+     equality against the true final state for EVERY row.
+
+Prints one JSON line and persists artifacts/RECOVERY_<commit>_<ts>.json.
+
+Usage: python tools/hw_recovery.py [n_sub] [width] [window_s]
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    n_sub = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    w = int(sys.argv[2]) if len(sys.argv) > 2 else 8192
+    window_s = float(sys.argv[3]) if len(sys.argv) > 3 else 10.0
+
+    import jax
+
+    from dint_tpu import recovery, stats as st
+    from dint_tpu.engines import tatp_dense as td
+    from dint_tpu.tables import log as logring
+
+    vw = 10
+    # ring sized from bench evidence (artifacts/BENCH_bce9c13: ~350k
+    # attempted/s => ~0.2 write rows/attempt => ~1M entries in a 15 s
+    # window, over 16 lanes): 2^18/lane = 4.2M total, ~4x headroom so the
+    # wrap-refusal path stays untriggered at full throughput
+    log_capacity = 1 << 18
+
+    t0 = time.time()
+    db0 = td.populate(np.random.default_rng(0), n_sub, val_words=vw,
+                      log_capacity=log_capacity)
+    snapshot = jax.tree.map(np.array, db0)     # host copy = durable base
+    populate_s = time.time() - t0
+
+    # the ring geometry rides in db0 (init(db0)); the runner shape-infers
+    run, init, drain = td.build_pipelined_runner(
+        n_sub, w=w, val_words=vw, cohorts_per_block=16)
+    carry = init(db0)
+    key = jax.random.PRNGKey(3)
+    t0 = time.time()
+    carry, s = run(carry, jax.random.fold_in(key, 999))
+    np.asarray(s)
+    compile_s = time.time() - t0
+
+    carry, total, _warm, dt, blocks, _bs = st.run_window(
+        run, carry, key, window_s, td.N_STATS, warmup_blocks=0)
+    db, tail = drain(carry)
+    total = total + np.asarray(tail, np.int64).sum(axis=0)
+    committed = int(total[td.STAT_COMMITTED])
+
+    heads = np.asarray(db.log.head)
+    final_val = np.asarray(db.val)
+    final_ver = np.asarray(db.ver)
+    final_exists = np.asarray(db.exists)
+
+    # device dies here: everything we keep is the snapshot + replica 1's
+    # ring (a BACKUP holder's stream — any one of the 3 suffices)
+    t0 = time.time()
+    rec = recovery.recover_tatp_dense(
+        jax.tree.map(jax.numpy.asarray, snapshot),
+        np.asarray(logring.replica_entries(db.log, 1)), heads)
+    equal_val = bool(np.array_equal(np.asarray(rec.val), final_val))
+    equal_ver = bool(np.array_equal(np.asarray(rec.ver), final_ver))
+    equal_exists = bool(np.array_equal(np.asarray(rec.exists),
+                                       final_exists))
+    rebuild_s = time.time() - t0
+    mutated = not np.array_equal(snapshot.ver, final_ver)
+
+    out = {
+        "metric": "tatp_recovery_at_bench_scale",
+        "ok": equal_val and equal_ver and equal_exists and mutated,
+        "equal_val": equal_val, "equal_ver": equal_ver,
+        "equal_exists": equal_exists, "state_mutated": mutated,
+        "n_subscribers": n_sub, "width": w, "window_s": round(dt, 2),
+        "blocks": blocks,
+        "committed_txns": committed,
+        "committed_tps": round(committed / dt, 1),
+        "log_entries_used": int(np.minimum(heads, log_capacity).sum()),
+        "log_head_max": int(heads.max()),
+        "log_capacity_per_lane": log_capacity,
+        "ring_wrapped": bool((heads > log_capacity).any()),
+        "populate_s": round(populate_s, 2),
+        "compile_s": round(compile_s, 2),
+        "rebuild_s": round(rebuild_s, 2),
+    }
+    try:
+        c = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                           capture_output=True, text=True, timeout=10)
+        out["commit"] = c.stdout.strip() or "unknown"
+    except Exception:
+        out["commit"] = "unknown"
+    out["ts"] = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+
+    art_dir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "artifacts")
+    os.makedirs(art_dir, exist_ok=True)
+    with open(os.path.join(
+            art_dir, f"RECOVERY_{out['commit']}_{out['ts']}.json"),
+            "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out), flush=True)
+    if not out["ok"]:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()
