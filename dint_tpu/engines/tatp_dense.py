@@ -429,13 +429,6 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
     logs = logring.append_rep(db.log, wmask, log_tbl, flags_del, zero_hi,
                               log_key, newver, newval)
 
-    # ---- wave 2 of c1: validate read-set version compare ------------------
-    vvB = meta[c1.rows]                                         # [w, K]
-    bad = c1.is_read & (vvB != c1.vv1)
-    changed = bad.any(axis=1)
-    c1 = c1.replace(alive=c1.alive & ~changed,
-                    ab_validate=(c1.alive & changed).sum(dtype=I32))
-
     # ---- wave 1: new cohort read + lock -----------------------------------
     if gen_new:
         ttype, ops, tbl, kk, ws = gen_cohort(kg, w, n_sub, mix=mix)
@@ -455,7 +448,20 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
     rows = jnp.where(used, base[tbl] + kk, sent)                # [w, K]
     is_read = ops == Op.OCC_READ
 
-    rmeta = meta[rows]                                          # [w, K]
+    # ONE fused meta gather serves wave 2 (c1's validate re-read) AND
+    # wave 1 (the new cohort's reads): TPUs execute HLOs sequentially, so
+    # every saved random-access pass is wall time (PERF.md round-3
+    # profile: 0.6-0.9 ms per 16-32k-index op)
+    g = meta[jnp.concatenate([c1.rows.reshape(-1), rows.reshape(-1)])]
+    vvB = g[: w * K].reshape(w, K)                              # [w, K]
+    rmeta = g[w * K:].reshape(w, K)                             # [w, K]
+
+    # ---- wave 2 of c1: validate read-set version compare ------------------
+    bad = c1.is_read & (vvB != c1.vv1)
+    changed = bad.any(axis=1)
+    c1 = c1.replace(alive=c1.alive & ~changed,
+                    ab_validate=(c1.alive & changed).sum(dtype=I32))
+
     vv1 = rmeta                     # ver<<1|exists — locks live elsewhere
     rex = (rmeta & 1) != 0
     rmagic = val[rows * val_words + 1]
