@@ -190,6 +190,9 @@ def run_point(results, name, fn, attempts=2, backoff_s=30):
     in, voiding every result), so a failed point retries once after a
     backoff and then records an error artifact instead of killing the
     sweep. Returns True if the point produced a measurement."""
+    if getattr(results, "already_done", lambda n: False)(name):
+        print(f"point {name}: skipped (already done)", flush=True)
+        return True
     err = "unknown"
     for attempt in range(attempts):
         if attempt:
@@ -704,21 +707,39 @@ class _ResultSink(dict):
     an old pre-`run_point` warmup) leaves every finished point on disk
     instead of voiding the sweep."""
 
-    def __init__(self, out: str):
+    def __init__(self, out: str, skip_done: bool = False):
         super().__init__()
         self.out = out
+        self.skip_done = skip_done
 
     def __setitem__(self, name, block):
         super().__setitem__(name, block)
         with open(os.path.join(self.out, f"{name}.json"), "w") as f:
             json.dump(block, f, indent=1)
 
+    def already_done(self, name) -> bool:
+        """--skip-done restart support: a hung tunnel can freeze a jax
+        call that run_point's exception retry cannot escape (observed
+        mid-round-5); the recovery story is kill + rerun with --skip-done,
+        which skips every point that already has a non-error artifact."""
+        if not self.skip_done:
+            return False
+        try:
+            with open(os.path.join(self.out, f"{name}.json")) as f:
+                block = json.load(f)
+        except (OSError, ValueError):
+            return False
+        if "error" in block:
+            return False              # failed points retry on restart
+        super().__setitem__(name, block)   # load for the summary
+        return True
+
 
 def run_all(out: str, window_s: float = 10.0, quick: bool = False,
-            only: str | None = None) -> dict:
+            only: str | None = None, skip_done: bool = False) -> dict:
     _platform_override()
     os.makedirs(out, exist_ok=True)
-    results: dict[str, dict] = _ResultSink(out)
+    results: dict[str, dict] = _ResultSink(out, skip_done=skip_done)
 
     # full sweep at the reference's workload scale: 7M subscribers
     # (tatp/caladan/tatp.h:28), 24M accounts (smallbank.h:16); widths
@@ -773,11 +794,14 @@ def main():
     ap.add_argument("--window", type=float, default=10.0)
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--only", default=None)
+    ap.add_argument("--skip-done", action="store_true",
+                    help="skip points whose non-error artifact already "
+                         "exists (restart after a hang/kill)")
     args = ap.parse_args()
     if args.quick and args.window == 10.0:
         args.window = 1.0
     results = run_all(args.out, window_s=args.window, quick=args.quick,
-                      only=args.only)
+                      only=args.only, skip_done=args.skip_done)
     for name in sorted(results):
         r = results[name]
         if "error" in r:

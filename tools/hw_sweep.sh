@@ -1,0 +1,32 @@
+#!/bin/bash
+# Hang-proof hardware sweep: the axon tunnel can freeze a jax call
+# mid-point (observed round 5: exp.py hung 9+ min inside open_90pct when
+# the tunnel dropped), which no in-process retry can escape. This wrapper
+# (a) probes the backend before each attempt, (b) bounds each sweep
+# attempt with `timeout`, and (c) restarts with --skip-done so finished
+# points are never re-measured. Exits 0 when a full pass completes.
+#
+# Usage: tools/hw_sweep.sh [out_dir] [per-attempt timeout seconds]
+cd "$(dirname "$0")/.." || exit 1
+OUT="${1:-exp_results}"
+ATTEMPT_T="${2:-3600}"
+
+for i in $(seq 1 12); do
+    echo "=== sweep attempt $i ==="
+    if ! timeout 60 python -c "import jax; print(float(jax.numpy.ones(2).sum()))" \
+            > /dev/null 2>&1; then
+        echo "backend unreachable; sleeping 120s"
+        sleep 120
+        continue
+    fi
+    timeout "$ATTEMPT_T" python exp.py --out "$OUT" --skip-done \
+        >> exp_stdout.log 2>> exp_run.log
+    rc=$?
+    echo "attempt $i rc=$rc ($(ls "$OUT" | wc -l) points)"
+    if [ "$rc" -eq 0 ]; then
+        echo "=== sweep complete ==="
+        exit 0
+    fi
+done
+echo "=== sweep gave up after 12 attempts ==="
+exit 1
