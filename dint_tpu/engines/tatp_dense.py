@@ -449,9 +449,11 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
     is_read = ops == Op.OCC_READ
 
     # ONE fused meta gather serves wave 2 (c1's validate re-read) AND
-    # wave 1 (the new cohort's reads): TPUs execute HLOs sequentially, so
-    # every saved random-access pass is wall time (PERF.md round-3
-    # profile: 0.6-0.9 ms per 16-32k-index op)
+    # wave 1 (the new cohort's reads). Both gathers depend on the same
+    # install scatter and on nothing else of each other, so XLA could
+    # overlap their DMAs (PERF.md round-3 finding 3) — the fusion still
+    # halves per-op launch/descriptor overhead on ops measured at
+    # 0.6-0.9 ms per 16-32k random indices
     g = meta[jnp.concatenate([c1.rows.reshape(-1), rows.reshape(-1)])]
     vvB = g[: w * K].reshape(w, K)                              # [w, K]
     rmeta = g[w * K:].reshape(w, K)                             # [w, K]
