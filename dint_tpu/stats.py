@@ -211,7 +211,12 @@ def run_latency_window(runner, state, key, window_s: float, n_stats: int,
     latency/throughput trade a latency-mode run exists to expose.
 
     Returns (state, total, dt, steps, percentiles dict with ``n`` =
-    cohort sample count)."""
+    cohort sample count). Totals note: a cohort's outcome stats surface
+    depth-1 steps after its dispatch, so the timed fetches (+ the
+    caller's drain) also capture the warmup cohorts' outcomes —
+    `total` covers warmup_blocks + steps dispatched cohorts (a
+    ~warmup/steps relative overcount vs the timed window, <1% at any
+    real window length)."""
     import jax
 
     for i in range(warmup_blocks):

@@ -11,7 +11,9 @@ cd "$(dirname "$0")/.." || exit 1
 OUT="${1:-exp_results}"
 ATTEMPT_T="${2:-3600}"
 
-for i in $(seq 1 12); do
+# outage patience: round-4's tunnel outage lasted ~11 h; probing is
+# nearly free, so wait out anything shorter than a full round (~10 h)
+for i in $(seq 1 200); do
     echo "=== sweep attempt $i ==="
     if ! timeout 60 python -c "import jax; print(float(jax.numpy.ones(2).sum()))" \
             > /dev/null 2>&1; then
@@ -28,5 +30,5 @@ for i in $(seq 1 12); do
         exit 0
     fi
 done
-echo "=== sweep gave up after 12 attempts ==="
+echo "=== sweep gave up after 200 attempts ==="
 exit 1
