@@ -645,13 +645,18 @@ def _tatp_wire_txn_bench(window_s, quick):
     from dint_tpu.clients import tatp_wire as tw
 
     n_sub = 2_000 if quick else 100_000
-    w = 128 if quick else 512
+    # w=2048 ≈ 2.7k lanes/shard in wave 1: ~11 chunks pipelined across 8
+    # sockets per shard, exercising the >256-in-flight path (the
+    # reference's uthread resend-loop concurrency,
+    # client_ebpf_shard.cc:643-677) instead of stair-stepping on _CHUNK
+    w = 128 if quick else 2048
 
     from dint_tpu.stats import LatencyReservoir, MetricBlock
 
     lat = LatencyReservoir()
     with tw.serve_shards(n_sub, width=4 * w, flush_us=500) as ports:
-        with tw.WireCoordinator(ports, n_sub, width=4 * w) as coord:
+        with tw.WireCoordinator(ports, n_sub, width=4 * w,
+                                n_socks=8) as coord:
             rng = np.random.default_rng(0)
             coord.run_cohort(rng, w)            # compile all wave shapes
             coord.stats = type(coord.stats)()
@@ -673,6 +678,8 @@ def _tatp_wire_txn_bench(window_s, quick):
         extra={"unit": "txn/s", "width": w, "n_subscribers": n_sub,
                "ab_lock": st.aborted_lock, "ab_missing": st.aborted_missing,
                "ab_validate": st.aborted_validate,
+               "ab_timeout": st.aborted_timeout,
+               "timeout_lanes": st.timeout_lanes,
                "transport": "udp_loopback_3shard"}).to_dict()
 
 
