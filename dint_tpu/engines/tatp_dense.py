@@ -374,7 +374,7 @@ class Installs:
 
 def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
               n_sub: int, val_words: int, gen_new: bool = True, mix=None,
-              emit_installs: bool = False):
+              emit_installs: bool = False, check_magic: bool = True):
     """One fused device step: commit wave of c2, validate wave of c1, and
     read+lock wave of a NEW cohort — ordered commits -> reads -> locks per
     row exactly like the generic engine's phase order (engines/tatp.
@@ -466,8 +466,15 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
 
     vv1 = rmeta                     # ver<<1|exists — locks live elsewhere
     rex = (rmeta & 1) != 0
-    rmagic = val[rows * val_words + 1]
-    magic_bad = jnp.sum(is_read & rex & (rmagic != MAGIC), dtype=I32)
+    if check_magic:
+        # the magic-parity oracle costs one [w,K] single-word gather over
+        # the 6.2 GB val array per step; check_magic=False is an A/B
+        # measurement knob (DINT_BENCH_CHECK_MAGIC=0) quantifying it —
+        # the default keeps the reference's every-read integrity check
+        rmagic = val[rows * val_words + 1]
+        magic_bad = jnp.sum(is_read & rex & (rmagic != MAGIC), dtype=I32)
+    else:
+        magic_bad = jnp.asarray(0, I32)
 
     # lock arbitration in [w, 2] write-slot space: first slot wins per row
     # (batched CAS, tatp/ebpf/shard_kern.c:251-297); losers and held rows
@@ -542,11 +549,13 @@ def rebase_stamps(db: DenseDB) -> DenseDB:
 
 
 def build_pipelined_runner(n_sub: int, w: int = 8192, val_words: int = 10,
-                           cohorts_per_block: int = 8, mix=None):
+                           cohorts_per_block: int = 8, mix=None,
+                           check_magic: bool = True):
     """jit(scan(pipe_step)) over carry (db, c1, c2); same contract as
     tatp_pipeline.build_pipelined_runner: returns (run, init, drain)."""
     assert 2 * w <= (1 << K_ARB), f"w={w} exceeds the arb slot field"
-    kw = dict(w=w, n_sub=n_sub, val_words=val_words)
+    kw = dict(w=w, n_sub=n_sub, val_words=val_words,
+              check_magic=check_magic)
 
     def scan_fn(carry, key):
         db, c1, c2 = carry
