@@ -229,7 +229,6 @@ def sweep_pipeline(name, runner_fn, extras_fn, n_stats, *, widths, cpb,
 
     def closed_point(w):
         def fn():
-            nonlocal peak, peak_w
             run, carry, drain = runner_fn(w, cpb)
             total, dt, p, cores = pipeline_closed(
                 run, carry, drain, n_stats, window_s=window_s, cpb=cpb,
@@ -238,14 +237,21 @@ def sweep_pipeline(name, runner_fn, extras_fn, n_stats, *, widths, cpb,
             extra.update(cores)
             extra["mode"] = "closed"
             extra["width"] = w
-            if peak is None or att / dt > peak:
-                peak, peak_w = att / dt, w
             return _metric_json(att, com, dt, p, extra)
 
         return fn
 
     for w in widths:
-        run_point(results, f"{name}_closed_w{w}", closed_point(w))
+        nm = f"{name}_closed_w{w}"
+        run_point(results, nm, closed_point(w))
+        # peak derives from the RESULT (measured now or loaded by
+        # --skip-done), so a resumed sweep still anchors its open-loop
+        # rates — the in-closure nonlocal update lost the anchor when
+        # every closed point was skipped on restart
+        blk = results.get(nm) or {}
+        if "throughput" in blk and (peak is None
+                                    or blk["throughput"] > peak):
+            peak, peak_w = blk["throughput"], blk.get("width", w)
     if peak is None:      # no closed point survived: no rate anchor
         return
 
