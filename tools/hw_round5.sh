@@ -1,32 +1,42 @@
 #!/bin/bash
-# Round-5 hardware measurement plan. Stages ordered by evidence value;
-# every stage persists durable output (artifacts/*.json, exp_results/*),
-# so a mid-plan tunnel drop keeps everything already measured.
-# Stage 1 (the full sweep) is the hang-proof resumable wrapper — run it
-# first; stages 2-5 each need the chip exclusively (don't overlap).
+# Round-5 hardware measurement plan, outage-aware. Waits for the tunnel,
+# then runs stages in diversity-first order: a late tunnel return still
+# lands one artifact of every kind before the long sweep. Every stage
+# persists durable output (artifacts/*.json, exp_results/*).
 cd "$(dirname "$0")/.." || exit 1
 
-echo "=== stage 1: resumable full sweep (closed/open/latency/micro/wire) ==="
-bash tools/hw_sweep.sh exp_results 2700
+echo "=== stage 0: wait for the tunnel ==="
+for i in $(seq 1 200); do
+    if timeout 60 python -c "import jax; print(float(jax.numpy.ones(2).sum()))" \
+            > /dev/null 2>&1; then
+        echo "backend reachable (attempt $i)"
+        break
+    fi
+    echo "unreachable (attempt $i); sleeping 120s"
+    sleep 120
+done
 
-echo "=== stage 2: fresh headline bench (fused-gather step) ==="
+echo "=== stage 1: fresh headline bench (fused-gather step) ==="
 DINT_BENCH_PROFILE=1 timeout 1500 python bench.py \
     > bench_out.json 2> bench_stderr.log
 tail -1 bench_out.json
+
+echo "=== stage 2: bench-scale recovery artifact ==="
+timeout 1800 python tools/hw_recovery.py 1000000 8192 10.0 \
+    2>> bench_stderr.log | tail -1
 
 echo "=== stage 3: component profile at reference scale ==="
 timeout 1500 python tools/profile_dense.py 8192 7000000 \
     > profile_out.log 2>&1 || true
 tail -16 profile_out.log
 
-echo "=== stage 4: width scaling probe (throughput knee past 32k) ==="
-for W in 32768 65536; do
-    DINT_BENCH_WIDTH=$W DINT_BENCH_BLOCK=8 timeout 1200 python bench.py \
-        2>> bench_stderr.log | tail -1
-done
-
-echo "=== stage 5: bench-scale recovery artifact ==="
-timeout 1800 python tools/hw_recovery.py 1000000 8192 10.0 \
+echo "=== stage 4: width + magic-oracle probes ==="
+DINT_BENCH_WIDTH=32768 DINT_BENCH_BLOCK=8 timeout 1200 python bench.py \
     2>> bench_stderr.log | tail -1
+DINT_BENCH_CHECK_MAGIC=0 timeout 1200 python bench.py \
+    2>> bench_stderr.log | tail -1
+
+echo "=== stage 5: resumable full sweep (remaining time) ==="
+bash tools/hw_sweep.sh exp_results 2700
 
 echo "=== done ==="
