@@ -1,0 +1,135 @@
+"""Pallas probe: random-row gather from an HBM-resident table via a ring
+of outstanding async DMAs, vs XLA's gather.
+
+Round-3 blocked the Pallas route on VMEM-resident tables (Mosaic rejects
+scalar VMEM stores; tools/profile_pallas.py). At reference scale the
+tables are HBM-resident anyway (6.2 GB val / 0.6 GB meta), so the
+relevant primitive is different: K random row reads from HBM. XLA's
+gather costs ~0.5-2 ms per 16-32k indices on this chip (PERF.md); if a
+Pallas kernel holding NSLOTS DMAs in flight beats that, the wave-1 /
+validate / magic chain is worth fusing into one kernel.
+
+Layout matches production (engines/tatp_dense.DenseDB.val): a tight
+interleaved 1-D word array, row r at [r*VW, (r+1)*VW) — NOT [N, VW],
+which TPU tiling pads 12.8x.
+
+Design: indices are prefetched to SMEM (PrefetchScalarGridSpec), the
+kernel walks them with a fori_loop keeping NSLOTS row-DMAs outstanding
+(slot i%NSLOTS waits before reuse), each DMA copying one VW-word row
+HBM->VMEM output.
+
+Usage: python tools/profile_pallas_hbm.py [K] [N_rows] [VW]
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+plat = os.environ.get("JAX_PLATFORMS")
+if plat:
+    jax.config.update("jax_platforms", plat)
+
+K = int(sys.argv[1]) if len(sys.argv) > 1 else 32_768
+N = int(sys.argv[2]) if len(sys.argv) > 2 else 15_400_002
+VW = int(sys.argv[3]) if len(sys.argv) > 3 else 10
+NSLOTS = 16
+ITERS = 8
+
+
+def gather_kernel(idx_ref, tab_ref, out_ref, sem):
+    """idx_ref: SMEM [K] i32 (prefetched row ids); tab_ref: HBM [N*VW]
+    u32; out_ref: [K*VW] u32; sem: DMA sems [NSLOTS]."""
+
+    def start(i):
+        r = idx_ref[i]
+        return pltpu.make_async_copy(
+            tab_ref.at[pl.ds(r * VW, VW)],
+            out_ref.at[pl.ds(i * VW, VW)],
+            sem.at[i % NSLOTS])
+
+    def prime(i, _):
+        start(i).start()
+        return 0
+
+    jax.lax.fori_loop(0, min(NSLOTS, K), prime, 0)
+
+    def body(i, _):
+        start(i).wait()          # slot free again
+
+        def issue(_):
+            start(i + NSLOTS).start()
+            return 0
+
+        jax.lax.cond(i + NSLOTS < K, issue, lambda _: 0, 0)
+        return 0
+
+    jax.lax.fori_loop(0, K, body, 0)
+
+
+@jax.jit
+def pallas_gather(tab, idx):
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(1,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA((NSLOTS,))],
+    )
+    return pl.pallas_call(
+        gather_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((K * VW,), jnp.uint32),
+    )(idx, tab)
+
+
+@jax.jit
+def xla_gather(tab, idx):
+    # production access pattern (tatp_dense.pipe_step wave-1 val reads)
+    flat = (idx[:, None] * VW + jnp.arange(VW, dtype=jnp.int32)).reshape(-1)
+    return tab[flat]
+
+
+def timeit(name, fn, *args, reps=3):
+    try:
+        out = fn(*args)
+        np.asarray(out[:8])
+    except Exception as e:
+        print(f"{name:24s} FAILED: {repr(e)[:300]}", flush=True)
+        return None
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(ITERS):
+            out = fn(*args)
+        np.asarray(out[:8])
+        best = min(best, (time.perf_counter() - t0) / ITERS)
+    print(f"{name:24s} {best * 1e3:8.3f} ms per {K} rows", flush=True)
+    return best
+
+
+def main():
+    rng = np.random.default_rng(0)
+    tab = jnp.asarray(rng.integers(0, 1 << 30, N * VW, np.int64)
+                      .astype(np.uint32))
+    idx = jnp.asarray(rng.integers(0, N, K).astype(np.int32))
+    print(f"table [{N}*{VW}] u32 = {N * VW * 4 / 1e9:.2f} GB, "
+          f"K={K}, NSLOTS={NSLOTS}")
+    x = timeit("xla gather", xla_gather, tab, idx)
+    p = timeit("pallas dma-ring gather", pallas_gather, tab, idx)
+    if x and p:
+        # correctness cross-check before believing any speedup
+        a = np.asarray(xla_gather(tab, idx))
+        b = np.asarray(pallas_gather(tab, idx))
+        print("outputs equal:", bool(np.array_equal(a, b)))
+        print(f"speedup: {x / p:.2f}x")
+
+
+if __name__ == "__main__":
+    main()
