@@ -36,6 +36,11 @@ DINT_BENCH_WIDTH=32768 DINT_BENCH_BLOCK=8 timeout 1200 python bench.py \
 DINT_BENCH_CHECK_MAGIC=0 timeout 1200 python bench.py \
     2>> bench_stderr.log | tail -1
 
+echo "=== stage 4.5: pallas dma-ring gather probe ==="
+timeout 900 python tools/profile_pallas_hbm.py \
+    > pallas_hbm.log 2>&1 || true
+tail -5 pallas_hbm.log
+
 echo "=== stage 5: resumable full sweep (remaining time) ==="
 bash tools/hw_sweep.sh exp_results 2700
 
