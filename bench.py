@@ -320,7 +320,10 @@ def _emit_stale(reason: str) -> bool:
                 and "integrity_checks" not in out):
             out["stale"] = True
             out["stale_reason"] = reason[:300]
-            print(json.dumps(out))
+            # flush: stdout is a PIPE under the driver (block-buffered);
+            # an unflushed line dies with the process when the driver
+            # kills mid-retry — the exact rc=124 this fallback exists for
+            print(json.dumps(out), flush=True)
             return True
         if fallback is None:
             fallback = out
@@ -336,7 +339,7 @@ def _emit_stale(reason: str) -> bool:
         fallback["stale"] = True
         fallback["stale_reason"] = reason[:300]
         fallback["stale_config_mismatch"] = True
-        print(json.dumps(fallback))
+        print(json.dumps(fallback), flush=True)
         return True
     return False
 
@@ -350,7 +353,7 @@ def _diag_json(reason: str, detail: str):
         "mode": "device_fused",
         "error": reason,
         "detail": detail[:500],
-    }))
+    }), flush=True)
 
 
 def main():
@@ -415,14 +418,20 @@ def main():
         # still yields a result); mark a lost secondary metric in the artifact
         lines = [ln for ln in stdout.splitlines() if ln.startswith("{")]
         if lines:
-            out = json.loads(lines[-1])
+            try:
+                out = json.loads(lines[-1])
+            except ValueError:
+                # child killed mid-write: a truncated line must fall
+                # through to the stale fallback, not crash the parent
+                fail(f"{reason}; truncated JSON line salvaged")
+                continue
             if rc != 0 and ("smallbank_committed_txns_per_sec" not in out
                             and "smallbank_error" not in out):
                 out["smallbank_error"] = (
                     f"secondary leg lost: {reason}; "
                     f"stderr tail: {stderr.strip()[-200:]}")
             _persist_artifact(out)
-            print(json.dumps(out))
+            print(json.dumps(out), flush=True)
             return
         fail(f"{reason}; stderr tail: {stderr.strip()[-300:]}")
 
