@@ -12,8 +12,8 @@ H, C = 4, 2          # 4 hosts x 2 chips on the 8-virtual-device mesh
 D = H * C
 
 
-def _run(n_sub_global, w, blocks, seed=0):
-    mesh = mh.make_mesh_2d(H, C)
+def _run(n_sub_global, w, blocks, seed=0, h=H, c=C):
+    mesh = mh.make_mesh_2d(h, c)
     state = mh.create_multihost(mesh, n_sub_global, val_words=VW,
                                 seed=seed)
     run, init, drain = mh.build_multihost_runner(
@@ -130,3 +130,19 @@ def test_matches_1d_sharded_totals():
 def test_two_hosts_refused():
     with pytest.raises(ValueError, match="3 hosts"):
         mh.create_multihost(mh.make_mesh_2d(2, 2), 64, val_words=VW)
+
+
+def test_reference_topology_3_hosts():
+    """The reference's exact machine count: 3 hosts (x2 chips). With
+    H == replication factor, each host backs up BOTH other hosts and
+    every row has a copy on every host — accounting still closes."""
+    _, total = _run(6 * 128, w=32, blocks=2, seed=3, h=3, c=2)
+    attempted = int(total[td.STAT_ATTEMPTED])
+    committed = int(total[td.STAT_COMMITTED])
+    assert attempted == 2 * 2 * 32 * 6
+    assert committed > 0
+    assert int(total[td.STAT_MAGIC_BAD]) == 0
+    outcomes = (committed + int(total[td.STAT_AB_LOCK])
+                + int(total[td.STAT_AB_MISSING])
+                + int(total[td.STAT_AB_VALIDATE]))
+    assert outcomes == attempted

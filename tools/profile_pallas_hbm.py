@@ -18,11 +18,11 @@ kernel walks them with a fori_loop keeping NSLOTS row-DMAs outstanding
 (slot i%NSLOTS waits before reuse), each DMA copying one VW-word row
 HBM->VMEM output.
 
-Usage: python tools/profile_pallas_hbm.py [K] [N_rows] [VW]
+Usage: python tools/profile_pallas_hbm.py [K] [N_rows] [VW] [--interpret]
 
-Semantics validated under pallas interpret mode on CPU (outputs equal
-XLA's gather at K=256/N=10k) — a TPU failure is a Mosaic/compile issue,
-not kernel logic.
+--interpret runs the kernel in pallas interpret mode (CPU-safe): this
+reproduces the semantics validation (outputs equal XLA's gather at
+K=256/N=10k), so a TPU failure is a Mosaic/compile issue, not logic.
 """
 from __future__ import annotations
 
@@ -40,9 +40,11 @@ plat = os.environ.get("JAX_PLATFORMS")
 if plat:
     jax.config.update("jax_platforms", plat)
 
-K = int(sys.argv[1]) if len(sys.argv) > 1 else 32_768
-N = int(sys.argv[2]) if len(sys.argv) > 2 else 15_400_002
-VW = int(sys.argv[3]) if len(sys.argv) > 3 else 10
+INTERPRET = "--interpret" in sys.argv
+argv = [a for a in sys.argv if a != "--interpret"]
+K = int(argv[1]) if len(argv) > 1 else (256 if INTERPRET else 32_768)
+N = int(argv[2]) if len(argv) > 2 else (10_000 if INTERPRET else 15_400_002)
+VW = int(argv[3]) if len(argv) > 3 else 10
 NSLOTS = 16
 ITERS = 8
 
@@ -90,6 +92,7 @@ def pallas_gather(tab, idx):
         gather_kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((K * VW,), jnp.uint32),
+        interpret=INTERPRET,
     )(idx, tab)
 
 
