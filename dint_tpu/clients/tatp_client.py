@@ -310,29 +310,69 @@ class Coordinator:
             c_tb = np.concatenate(w_tb).astype(np.int32)
             c_key = np.concatenate(w_key).astype(np.int64)
             c_kind = np.concatenate(w_kind).astype(np.int32)
-            c_val = np.zeros((len(c_tb), self.vw), np.uint32)
-            c_val[:, 0] = rng.integers(0, 1 << 16, size=len(c_tb)).astype(np.uint32)
+            c_txn = np.concatenate(w_txn)
+            n_l = len(c_tb)
+            c_val = np.zeros((n_l, self.vw), np.uint32)
+            c_val[:, 0] = rng.integers(0, 1 << 16, size=n_l).astype(np.uint32)
             c_val[:, 1] = MAGIC
             prim = (c_key % N_SHARDS).astype(np.int64)
-            log_op = np.where(c_kind == 2, Op.DELETE_LOG, Op.COMMIT_LOG).astype(np.int32)
+
+            # every commit wave's replies are captured: a TIMEOUT lane in
+            # CommitLog x3 / CommitBck x2 puts its WHOLE txn in doubt
+            # exactly like a lost CommitPrim reply below — previously a
+            # timed-out log/backup lane silently committed with no
+            # guaranteed WAL entry or replica copy. The reference treats
+            # the log acks as the commit point and resends until acked
+            # (client_ebpf_shard.cc:779-860); with a capped resend budget
+            # the honest outcome is abort + aborted_timeout, and later
+            # waves SKIP the doubted txn's lanes so an unlogged write is
+            # never installed.
+            lane_to = np.zeros(n_l, bool)
+
+            def wave(opv, shard_of):
+                """Run one commit wave over the lanes of still-clean txns;
+                fold TIMEOUT lanes into the doubt set (txn granularity).
+                Returns the replies of the lanes actually sent."""
+                d = np.zeros(w, bool)
+                np.logical_or.at(d, c_txn, lane_to)
+                idx = np.nonzero(~d[c_txn])[0]
+                rtw = np.zeros(0, np.int32)
+                if len(idx):
+                    rtw, _, _ = self._run_wave(opv[idx], c_tb[idx],
+                                               c_key[idx], shard_of[idx],
+                                               c_val[idx])
+                    lane_to[idx] |= rtw == Reply.TIMEOUT
+                return rtw
+
+            log_op = np.where(c_kind == 2, Op.DELETE_LOG,
+                              Op.COMMIT_LOG).astype(np.int32)
             for s in range(N_SHARDS):
-                self._run_wave(log_op, c_tb, c_key, np.full(len(c_tb), s), c_val)
+                wave(log_op, np.full(n_l, s))
             bck = np.vectorize(bk_ops.get)(c_kind).astype(np.int32)
             for off in (1, 2):
-                self._run_wave(bck, c_tb, c_key, (prim + off) % N_SHARDS, c_val)
+                wave(bck, (prim + off) % N_SHARDS)
             pr = np.vectorize(wr_ops.get)(c_kind).astype(np.int32)
-            prt, _, _ = self._run_wave(pr, c_tb, c_key, prim, c_val)
+            prt = wave(pr, prim)
             assert (prt != Reply.NONE).all()
-            # a lost CommitPrim reply leaves the txn in doubt (the primary
-            # may or may not have installed); count it as a timeout abort —
-            # conservative vs the reference, which resends until acked
+
             in_doubt = np.zeros(w, bool)
-            np.logical_or.at(in_doubt, np.concatenate(w_txn),
-                             prt == Reply.TIMEOUT)
+            np.logical_or.at(in_doubt, c_txn, lane_to)
             st.aborted_timeout += int((alive & in_doubt).sum())
             alive = alive & ~in_doubt
 
         # ---- abort unlocks: granted locks of dead RW txns -------------------
+        # In-doubt txns (TIMEOUT lanes above) are unlocked here too. That
+        # is safe only under this client's SINGLE-COORDINATOR deployment:
+        # one Coordinator owns every in-flight txn (the reference
+        # benchmarks likewise run one client process per experiment,
+        # client_ebpf_shard.cc), so no other coordinator can observe the
+        # row between the lost reply and this ABORT, and an ABORT for a
+        # lock whose GRANT reply was lost simply finds the lock already
+        # held by us and releases it. With multiple coordinators an
+        # in-doubt txn would need resolution (resend until acked, as the
+        # reference does, or a recovery pass over the log x3) BEFORE its
+        # locks could be released — releasing early would let another
+        # coordinator certify against a possibly-installed write.
         dead = rw & ~alive
         ab_lane = is_lock_lane & (r_rt == Reply.GRANT) & dead[:, None]
         a_used = ab_lane.reshape(-1)

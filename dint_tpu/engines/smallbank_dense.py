@@ -76,6 +76,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..ops import pallas_gather as pg
 from ..tables import log as logring
 from .types import Op
 from .smallbank_pipeline import (AMT, L, MAGIC, N_SHARDS, TS_AMT_MAX, VW,     # noqa: F401 (re-exported)
@@ -200,10 +201,18 @@ def _stats_of(c: BankCtx):
 
 
 def pipe_step(db: DenseBank, c1: BankCtx, key, *, w: int, n_accounts: int,
-              gen_new: bool = True, hot_frac=None, hot_prob=None, mix=None):
+              gen_new: bool = True, hot_frac=None, hot_prob=None, mix=None,
+              use_pallas: bool = False):
     """One fused device step: wave 1 of a NEW cohort acquires against c1's
     STILL-HELD stamps (stamp == step-1), then wave 2 installs c1's writes.
-    Returns (db', new_ctx, stats-of-c1)."""
+    Returns (db', new_ctx, stats-of-c1).
+
+    ``use_pallas`` (static) routes the step's random single-word gathers —
+    the held-stamp reads on x_step/s_step and the fused balance read —
+    through the DMA-ring kernel (ops/pallas_gather.gather_rows),
+    bit-identical to the XLA gathers; the scatter-min arbitration and the
+    install scatters stay XLA (they are already 1-D unique-index fast
+    paths)."""
     m1 = 2 * n_accounts + 1
     sent = m1 - 1
     oob = m1
@@ -242,8 +251,12 @@ def pipe_step(db: DenseBank, c1: BankCtx, key, *, w: int, n_accounts: int,
         jnp.where(is_s_lane, slot, h)].min(lane, mode="drop")
     # held = stamped by the previous step's cohort (released implicitly
     # one step later; acquire-before-release semantics preserved)
-    held_x = db.x_step[slot] == t - 1
-    held_s = db.s_step[slot] == t - 1
+    if use_pallas:
+        held_x = pg.gather_rows(db.x_step, slot, 1) == t - 1
+        held_s = pg.gather_rows(db.s_step, slot, 1) == t - 1
+    else:
+        held_x = db.x_step[slot] == t - 1
+        held_s = db.s_step[slot] == t - 1
     slot_free = ~held_x & ~held_s
     x_wins = (first_x[slot] < first_s[slot]) & slot_free
     grant_x = is_x_lane & x_wins & (first_x[slot] == lane)
@@ -261,7 +274,9 @@ def pipe_step(db: DenseBank, c1: BankCtx, key, *, w: int, n_accounts: int,
 
     # fused reads from the pre-install table: rows c1 installs below were
     # X-stamped by c1, so this cohort never granted (or consumed) them
-    bal = jnp.where(granted, db.bal[flat_rows].astype(I32).reshape(w, L), 0)
+    raw_bal = (pg.gather_rows(db.bal, flat_rows, 1) if use_pallas
+               else db.bal[flat_rows])
+    bal = jnp.where(granted, raw_bal.astype(I32).reshape(w, L), 0)
 
     nw, do, logic_abort, commit, committed = compute_phase(
         ttype, bal, alive, ts_amt)
@@ -302,13 +317,17 @@ def pipe_step(db: DenseBank, c1: BankCtx, key, *, w: int, n_accounts: int,
 
 def build_pipelined_runner(n_accounts: int, w: int = 8192,
                            cohorts_per_block: int = 8, hot_frac=None,
-                           hot_prob=None, mix=None):
+                           hot_prob=None, mix=None, use_pallas=None):
     """jit(scan(pipe_step)) over carry (db, c1). Returns (run, init, drain):
       run(carry, key) -> (carry', stats [cohorts_per_block, N_STATS])
       init(db)        -> carry with one bootstrap cohort in flight
       drain(carry)    -> (db, stats [1, N_STATS]) flushing the pipeline
+
+    ``use_pallas``: None = honor DINT_USE_PALLAS env; Mosaic failure falls
+    back to the XLA gathers (ops/pallas_gather.resolve_use_pallas).
     """
-    kw = dict(w=w, n_accounts=n_accounts)
+    use_pallas = pg.resolve_use_pallas(use_pallas, n_idx=w * L, m_lock=None)
+    kw = dict(w=w, n_accounts=n_accounts, use_pallas=use_pallas)
     kw_gen = dict(kw, hot_frac=hot_frac, hot_prob=hot_prob, mix=mix)
 
     def scan_fn(carry, key):

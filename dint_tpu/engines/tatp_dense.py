@@ -99,6 +99,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..ops import pallas_gather as pg
 from ..tables import log as logring
 from . import tatp
 from .types import Op, Reply
@@ -374,14 +375,25 @@ class Installs:
 
 def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
               n_sub: int, val_words: int, gen_new: bool = True, mix=None,
-              emit_installs: bool = False, check_magic: bool = True):
+              emit_installs: bool = False, check_magic: bool = True,
+              use_pallas: bool = False):
     """One fused device step: commit wave of c2, validate wave of c1, and
     read+lock wave of a NEW cohort — ordered commits -> reads -> locks per
     row exactly like the generic engine's phase order (engines/tatp.
     _dense_step), so cohort t-2's installs are visible to t-1's validation
     and this step's reads, and its unlocks free rows for this step's lock
     acquires. Returns (db', new_ctx, c1', stats-of-c2), plus the Installs
-    record when ``emit_installs`` (static) is set."""
+    record when ``emit_installs`` (static) is set.
+
+    ``use_pallas`` (static) routes the step's random-access hot ops through
+    the Pallas DMA-ring kernels (ops/pallas_gather): the fused meta gather
+    and the magic-word gather become ring gathers, and the 3-op lock chain
+    (arb gather -> masked scatter-max -> winner gather-back) collapses into
+    ONE fused kernel pass — shortening the step's random-access dependency
+    chain from ~5 chained XLA ops to ~3. Outputs are bit-identical to the
+    XLA path (tests/test_pallas_ops.py); builders resolve the flag via
+    pg.resolve_use_pallas, which degrades to False when Mosaic rejects a
+    kernel."""
     p1 = n_sub + 1
     n1 = n_rows(n_sub) + 1
     sent = n1 - 1     # sentinel row: gathered by NOP lanes, never written
@@ -454,7 +466,8 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
     # overlap their DMAs (PERF.md round-3 finding 3) — the fusion still
     # halves per-op launch/descriptor overhead on ops measured at
     # 0.6-0.9 ms per 16-32k random indices
-    g = meta[jnp.concatenate([c1.rows.reshape(-1), rows.reshape(-1)])]
+    gidx = jnp.concatenate([c1.rows.reshape(-1), rows.reshape(-1)])
+    g = pg.gather_rows(meta, gidx, 1) if use_pallas else meta[gidx]
     vvB = g[: w * K].reshape(w, K)                              # [w, K]
     rmeta = g[w * K:].reshape(w, K)                             # [w, K]
 
@@ -471,7 +484,9 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
         # the 6.2 GB val array per step; check_magic=False is an A/B
         # measurement knob (DINT_BENCH_CHECK_MAGIC=0) quantifying it —
         # the default keeps the reference's every-read integrity check
-        rmagic = val[rows * val_words + 1]
+        midx = (rows * val_words + 1).reshape(-1)
+        rmagic = (pg.gather_rows(val, midx, 1).reshape(w, K)
+                  if use_pallas else val[midx].reshape(w, K))
         magic_bad = jnp.sum(is_read & rex & (rmagic != MAGIC), dtype=I32)
     else:
         magic_bad = jnp.asarray(0, I32)
@@ -488,14 +503,22 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
     ws_vv = jnp.take_along_axis(rmeta, ws_lane, axis=1)
     flat_ws = ws_rows.reshape(-1)
     active = ws_active.reshape(-1)
-    arb_old = db.arb[flat_ws]       # [2w]; sentinel row is never stamped
-    held = (arb_old >> K_ARB) == (t - 1)
-    inv_slot = U32(2 * w - 1) - jnp.arange(2 * w, dtype=U32)
-    packed = (t << K_ARB) | inv_slot
-    cand = active & ~held
-    arb = db.arb.at[jnp.where(cand, flat_ws, oob)].max(packed,
-                                                       mode="drop")
-    grant = (cand & (arb[flat_ws] == packed)).reshape(w, 2)
+    if use_pallas:
+        # fused kernel pass: gather + stamp compare + first-lane-wins
+        # scatter-max + winner read-back in ONE launch, arb updated in
+        # place (bit-identical to the XLA chain below — pinned in
+        # tests/test_pallas_ops.py)
+        arb, grant_u = pg.lock_arbitrate(db.arb, flat_ws, active, t, K_ARB)
+        grant = (grant_u != 0).reshape(w, 2)
+    else:
+        arb_old = db.arb[flat_ws]   # [2w]; sentinel row is never stamped
+        held = (arb_old >> K_ARB) == (t - 1)
+        inv_slot = U32(2 * w - 1) - jnp.arange(2 * w, dtype=U32)
+        packed = (t << K_ARB) | inv_slot
+        cand = active & ~held
+        arb = db.arb.at[jnp.where(cand, flat_ws, oob)].max(packed,
+                                                           mode="drop")
+        grant = (cand & (arb[flat_ws] == packed)).reshape(w, 2)
 
     # reply types: reads from the gather; write-slot GRANT/REJECT direct
     rt = jnp.where(is_read & used,
@@ -550,12 +573,19 @@ def rebase_stamps(db: DenseDB) -> DenseDB:
 
 def build_pipelined_runner(n_sub: int, w: int = 8192, val_words: int = 10,
                            cohorts_per_block: int = 8, mix=None,
-                           check_magic: bool = True):
+                           check_magic: bool = True, use_pallas=None):
     """jit(scan(pipe_step)) over carry (db, c1, c2); same contract as
-    tatp_pipeline.build_pipelined_runner: returns (run, init, drain)."""
+    tatp_pipeline.build_pipelined_runner: returns (run, init, drain).
+
+    ``use_pallas``: None = honor DINT_USE_PALLAS env; True/False forces.
+    When requested, the Pallas kernels are probed at this runner's lane
+    geometry and a Mosaic failure falls back to the XLA path with a logged
+    warning (ops/pallas_gather.resolve_use_pallas)."""
     assert 2 * w <= (1 << K_ARB), f"w={w} exceeds the arb slot field"
+    use_pallas = pg.resolve_use_pallas(use_pallas, n_idx=2 * w * K,
+                                       m_lock=2 * w, k_arb=K_ARB)
     kw = dict(w=w, n_sub=n_sub, val_words=val_words,
-              check_magic=check_magic)
+              check_magic=check_magic, use_pallas=use_pallas)
 
     def scan_fn(carry, key):
         db, c1, c2 = carry

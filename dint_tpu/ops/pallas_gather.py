@@ -1,0 +1,360 @@
+"""Pallas/Mosaic DMA-ring kernels for batched random access over the
+HBM-resident dense tables.
+
+PERF.md "Where the remaining 2.5x lives": the dense engines' step cost is
+pinned to a short serialized chain of random-access HBM ops (gathers /
+scatter-max / gather-back) at ~0.6-0.9 ms per 16-32k random indices each —
+XLA emits one device op per access with no way to overlap a chain that is
+data-dependent. The reference collapses its per-request path into ONE fused
+in-kernel pass at the NIC (tatp/ebpf/shard_kern.c); this module is the TPU
+analogue: kernels that walk K random rows with a ring of NSLOTS outstanding
+row DMAs (HBM latency hiding inside one kernel launch) instead of N chained
+XLA gather ops.
+
+Two kernel families, both production entry points behind `DINT_USE_PALLAS`
+(env) / `use_pallas=` (engine kwarg):
+
+* `gather_rows(tab, idx, vw)` — the wave-1/validate/magic reads: K rows of
+  `vw` u32 words from a tight interleaved 1-D table (row r's words at
+  [r*vw, (r+1)*vw), the engines/tatp_dense.DenseDB.val layout). Indices are
+  prefetched to SMEM (PrefetchScalarGridSpec), the kernel keeps NSLOTS row
+  DMAs in flight. Semantics == `tab[(idx[:,None]*vw + arange(vw)).ravel()]`
+  bit for bit (pinned in tests/test_pallas_ops.py); indices MUST be
+  in-bounds — the engines clamp masked lanes onto the sentinel row, and
+  unlike XLA's clipping gather a Pallas DMA from an out-of-range offset is
+  undefined.
+
+* `lock_arbitrate(arb, rows, active, step, k_arb)` — the fused
+  gather -> stamp-compare -> scatter-max lock path of engines/tatp_dense:
+  ONE kernel pass replaces the 3-op chain (arb gather, masked scatter-max
+  of `(step << k_arb) | (M-1-lane)`, winner gather-back). The kernel walks
+  the M write-slot lanes in order doing a read-modify-write per lane:
+  first ACTIVE lane on a free row wins the stamp, later lanes observe
+  either the in-batch stamp (step field == step) or the previous step's
+  stamp (== step-1) and reject. That sequential rule is EXACTLY the XLA
+  scatter-max outcome (max of the packed stamps == smallest lane index,
+  proof in tests/test_pallas_ops.py::test_lock_arbitrate_matches_xla): the
+  arb array and grant vector are bit-identical to the XLA path. The arb
+  input is donated (input_output_aliases), so the 0.6 GB array is updated
+  in place. Hardware hazard discipline: reads run NSLOTS ahead of the
+  RMW point, a write DMA is force-waited when its slot is reused (lag
+  NSLOTS), and an SMEM window of the last 2*NSLOTS granted rows catches
+  the only writes a prefetched read can miss — so in-batch duplicates
+  arbitrate correctly even with the ring fully in flight.
+
+Fallback contract (ISSUE 1): Mosaic rejection must DEGRADE, not crash —
+round 3 already hit one such rejection class (scalar VMEM stores,
+tools/profile_pallas.py). `resolve_use_pallas()` therefore compiles + runs
+both kernels at the caller's real lane geometry (tiny tables — the failure
+modes are construct/SMEM-budget level, not table-size level) and verifies
+the gather against `jnp.take` before saying yes; any exception or mismatch
+logs one warning and returns False, and every builder falls back to the
+XLA path. On CPU every kernel runs under `interpret=True` (the Mosaic
+pipeline never runs), which is what makes the whole layer tier-1-testable
+without hardware.
+"""
+from __future__ import annotations
+
+import functools
+import logging
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+NSLOTS = 16      # outstanding row DMAs in the gather ring
+RMW_SLOTS = 8    # outstanding read DMAs in the lock RMW ring
+WIN = 2 * RMW_SLOTS   # recent-grant window: covers every write a read
+#                       prefetched RMW_SLOTS ahead can race (see module doc)
+
+log = logging.getLogger("dint_tpu.pallas")
+
+
+def use_interpret() -> bool:
+    """interpret=True off-TPU (CPU tier-1 tests, virtual meshes); the env
+    override exists so hardware debugging can force either mode."""
+    env = os.environ.get("DINT_PALLAS_INTERPRET")
+    if env is not None:
+        return env != "0"
+    return jax.default_backend() != "tpu"
+
+
+def env_use_pallas() -> bool:
+    return os.environ.get("DINT_USE_PALLAS", "0") not in ("", "0")
+
+
+# ------------------------------------------------------------- row gather
+
+
+def _gather_kernel(vw: int, nslots: int, idx_ref, tab_ref, out_ref, sem):
+    """idx_ref: SMEM [K] i32 row ids (prefetched); tab_ref: ANY [N*vw] u32;
+    out_ref: ANY [K*vw] u32; sem: DMA sems [nslots]. Ring of nslots
+    outstanding one-row HBM->HBM copies (validated against XLA's gather in
+    interpret mode AND at K=256/N=10k geometry by tools/profile_pallas_hbm)."""
+    k = idx_ref.shape[0]
+
+    def copy(i):
+        r = idx_ref[i]
+        return pltpu.make_async_copy(
+            tab_ref.at[pl.ds(r * vw, vw)],
+            out_ref.at[pl.ds(i * vw, vw)],
+            sem.at[jax.lax.rem(i, nslots)])
+
+    def prime(i, _):
+        copy(i).start()
+        return 0
+
+    jax.lax.fori_loop(0, min(nslots, k), prime, 0)
+
+    def body(i, _):
+        copy(i).wait()               # slot free again
+
+        def issue(_):
+            copy(i + nslots).start()
+            return 0
+
+        jax.lax.cond(i + nslots < k, issue, lambda _: 0, 0)
+        return 0
+
+    jax.lax.fori_loop(0, k, body, 0)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def gather_rows(tab, idx, vw: int = 1, interpret: bool | None = None):
+    """K random rows of `vw` u32 words from the flat table `tab`
+    (row r at [r*vw, (r+1)*vw)). Returns u32 [K*vw] — bit-identical to
+    `tab[(idx[:,None]*vw + arange(vw)).reshape(-1)]` for in-bounds idx.
+    `vw=1` covers the meta/arb/bal/stamp single-word gathers; callers that
+    need one word at an offset inside wider rows pass pre-scaled flat word
+    indices with vw=1 (e.g. the magic check's `rows*VW + 1`)."""
+    if interpret is None:
+        interpret = use_interpret()
+    k = idx.shape[0]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(1,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA((NSLOTS,))],
+    )
+    return pl.pallas_call(
+        functools.partial(_gather_kernel, vw, NSLOTS),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((k * vw,), U32),
+        interpret=bool(interpret),
+    )(idx.astype(I32), tab)
+
+
+# ------------------------------------------------------- fused lock pass
+
+
+def _arbitrate_kernel(k_arb: int, rows_ref, act_ref, t_ref, arb_in,
+                      arb_out, grant_out, rbuf, wbuf, gbuf, win_row,
+                      rsem, wsem, gsem):
+    """Sequential first-lane-wins RMW over M lock lanes — the fused form of
+    gather -> scatter-max -> gather-back (bit-equivalence argument in the
+    module docstring). arb_in/arb_out alias (in-place update of the HBM
+    array); grants accumulate in SMEM and leave in one trailing DMA."""
+    m = rows_ref.shape[0]
+    t = t_ref[0]
+
+    def read(i):
+        return pltpu.make_async_copy(
+            arb_out.at[pl.ds(rows_ref[i], 1)],
+            rbuf.at[pl.ds(jax.lax.rem(i, RMW_SLOTS), 1)],
+            rsem.at[jax.lax.rem(i, RMW_SLOTS)])
+
+    def write(i):
+        return pltpu.make_async_copy(
+            wbuf.at[pl.ds(jax.lax.rem(i, RMW_SLOTS), 1)],
+            arb_out.at[pl.ds(rows_ref[i], 1)],
+            wsem.at[jax.lax.rem(i, RMW_SLOTS)])
+
+    def init_win(i, _):
+        win_row[i] = I32(-1)
+        return 0
+
+    jax.lax.fori_loop(0, WIN, init_win, 0)
+
+    def init_wbuf(i, _):
+        # wbuf doubles as the per-slot write-in-flight flag: packed stamps
+        # are never 0 (step >= 2), so nonzero == a write DMA to force-wait
+        wbuf[i] = U32(0)
+        return 0
+
+    jax.lax.fori_loop(0, RMW_SLOTS, init_wbuf, 0)
+
+    def prime(i, _):
+        read(i).start()
+        return 0
+
+    jax.lax.fori_loop(0, min(RMW_SLOTS, m), prime, 0)
+
+    def body(i, _):
+        s = jax.lax.rem(i, RMW_SLOTS)
+        # a write DMA still in flight on this slot belongs to lane
+        # i - RMW_SLOTS: force-wait it so (a) wbuf[s] is reusable and
+        # (b) every write older than the ring depth has LANDED before the
+        # reads issued this iteration (the hazard-window invariant)
+        @pl.when(jnp.logical_and(i >= RMW_SLOTS,
+                                 wbuf[jax.lax.rem(i, RMW_SLOTS)] != U32(0)))
+        def _():
+            write(i - RMW_SLOTS).wait()
+
+        wbuf[s] = U32(0)
+
+        read(i).wait()
+        old = rbuf[s]
+        r = rows_ref[i]
+
+        # writes a ring-prefetched read can have missed are exactly the
+        # last WIN lanes' grants — scan the SMEM window for this row
+        def scan(j, hit):
+            return jnp.logical_or(hit, win_row[j] == r)
+
+        taken_win = jax.lax.fori_loop(0, WIN, scan, False)
+
+        stamp = old >> k_arb
+        held = stamp == t - U32(1)              # stamped by the previous step
+        taken = jnp.logical_or(stamp == t, taken_win)   # in-batch winner
+        grant = jnp.logical_and(act_ref[i] != 0,
+                                jnp.logical_not(jnp.logical_or(held, taken)))
+
+        gbuf[i] = jax.lax.select(grant, U32(1), U32(0))
+        win_row[jax.lax.rem(i, WIN)] = jax.lax.select(grant, r, I32(-1))
+
+        @pl.when(grant)
+        def _():
+            inv = U32(m - 1) - i.astype(U32)    # == XLA's inverted slot
+            wbuf[s] = (t << k_arb) | inv
+            write(i).start()
+
+        @pl.when(i + RMW_SLOTS < m)
+        def _():
+            read(i + RMW_SLOTS).start()
+
+        return 0
+
+    jax.lax.fori_loop(0, m, body, 0)
+
+    def drain(j, _):
+        i = m - min(RMW_SLOTS, m) + j
+
+        @pl.when(wbuf[jax.lax.rem(i, RMW_SLOTS)] != U32(0))
+        def _():
+            write(i).wait()
+
+        return 0
+
+    jax.lax.fori_loop(0, min(RMW_SLOTS, m), drain, 0)
+
+    out = pltpu.make_async_copy(gbuf, grant_out, gsem)
+    out.start()
+    out.wait()
+
+
+@functools.partial(jax.jit, static_argnums=(4, 5))
+def lock_arbitrate(arb, rows, active, step, k_arb: int,
+                   interpret: bool | None = None):
+    """Fused lock pass over the step-stamped arb array (engines/tatp_dense
+    layout: `step << k_arb | inverted_slot`). Returns (arb', grant u32[M])
+    bit-identical to the XLA chain
+
+        old  = arb[rows]; held = (old >> k_arb) == step - 1
+        cand = active & ~held
+        arb' = arb.at[where(cand, rows, oob)].max((step << k_arb)
+                                                  | (M-1 - lane), "drop")
+        grant = cand & (arb'[rows] == packed)
+
+    for in-bounds rows (masked lanes must carry active=False and a valid
+    sentinel row id, exactly what pipe_step already does). The arb buffer
+    is donated and updated in place."""
+    if interpret is None:
+        interpret = use_interpret()
+    m = rows.shape[0]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(1,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=(pl.BlockSpec(memory_space=pltpu.ANY),
+                   pl.BlockSpec(memory_space=pltpu.ANY)),
+        scratch_shapes=[
+            pltpu.SMEM((RMW_SLOTS,), U32),    # rbuf: in-flight read words
+            pltpu.SMEM((RMW_SLOTS,), U32),    # wbuf: in-flight write words
+            pltpu.SMEM((m,), U32),            # gbuf: per-lane grant bits
+            pltpu.SMEM((WIN,), I32),          # win_row: recent granted rows
+            pltpu.SemaphoreType.DMA((RMW_SLOTS,)),
+            pltpu.SemaphoreType.DMA((RMW_SLOTS,)),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+    )
+    arb2, grant = pl.pallas_call(
+        functools.partial(_arbitrate_kernel, k_arb),
+        grid_spec=grid_spec,
+        out_shape=(jax.ShapeDtypeStruct(arb.shape, U32),
+                   jax.ShapeDtypeStruct((m,), U32)),
+        # operand 3 (post scalar-prefetch) -> output 0: in-place arb update
+        input_output_aliases={3: 0},
+        interpret=bool(interpret),
+    )(rows.astype(I32), active.astype(I32),
+      step.reshape(1).astype(U32), arb)
+    return arb2, grant
+
+
+# ------------------------------------------------------ fallback plumbing
+
+_probe_cache: dict[tuple, bool] = {}
+
+
+def kernels_available(n_idx: int = 512, m_lock: int | None = 64,
+                      k_arb: int = 18) -> bool:
+    """Compile AND run both kernels at the caller's lane geometry (small
+    tables — SMEM budget scales with lane count, not table bytes), checking
+    the gather against jnp.take. Any exception or mismatch => False. Cached
+    per (backend, interpret, geometry): the probe costs one small compile
+    per runner configuration, once per process."""
+    key = (jax.default_backend(), use_interpret(), n_idx, m_lock, k_arb)
+    hit = _probe_cache.get(key)
+    if hit is not None:
+        return hit
+    ok = True
+    try:
+        n = 64
+        tab = jnp.arange(n * 4, dtype=U32)
+        idx = (jnp.arange(n_idx, dtype=I32) * 7) % n
+        got = gather_rows(tab, idx, 4)
+        want = jnp.take(tab.reshape(n, 4), idx, axis=0).reshape(-1)
+        if not bool(jnp.array_equal(got, want)):
+            raise RuntimeError("gather_rows output != XLA gather")
+        if m_lock is not None:
+            arb = jnp.zeros((n + 1,), U32)
+            rows = (jnp.arange(m_lock, dtype=I32) * 3) % n
+            act = jnp.ones((m_lock,), bool)
+            arb2, grant = lock_arbitrate(arb, rows, act,
+                                         jnp.asarray(2, U32), k_arb)
+            jax.block_until_ready((arb2, grant))
+    except Exception as e:  # Mosaic rejection / SMEM overflow / interp bug
+        log.warning("pallas kernels unavailable on %s (falling back to the "
+                    "XLA gather path): %r", jax.default_backend(),
+                    repr(e)[:300])
+        ok = False
+    _probe_cache[key] = ok
+    return ok
+
+
+def resolve_use_pallas(explicit: bool | None = None, *, n_idx: int = 512,
+                       m_lock: int | None = 64, k_arb: int = 18) -> bool:
+    """Engine-builder entry point: explicit kwarg wins, else the
+    DINT_USE_PALLAS env; when requested, the availability probe runs at the
+    builder's real lane geometry and a Mosaic failure degrades to False
+    (logged warning, never an exception)."""
+    if explicit is None:
+        explicit = env_use_pallas()
+    if not explicit:
+        return False
+    return kernels_available(n_idx=n_idx, m_lock=m_lock, k_arb=k_arb)

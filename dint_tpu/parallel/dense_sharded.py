@@ -46,8 +46,9 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..engines import tatp_dense as td
+from ..ops import pallas_gather as pg
 from ..tables import log as logring
-from .sharded import SHARD_AXIS, make_mesh   # noqa: F401 (re-exported)
+from .sharded import SHARD_AXIS, make_mesh, pcast_varying   # noqa: F401 (re-exported)
 
 I32 = jnp.int32
 U32 = jnp.uint32
@@ -138,18 +139,27 @@ def _apply_backup(state: ShardState, inst: td.Installs, slot: int,
 def build_sharded_pipelined_runner(mesh: Mesh, n_shards: int,
                                    n_sub_global: int, w: int = 4096,
                                    val_words: int = 10,
-                                   cohorts_per_block: int = 8, mix=None):
+                                   cohorts_per_block: int = 8, mix=None,
+                                   use_pallas=None):
     """jit(shard_map(scan(step)))) over stacked carry. Same contract shape
     as the single-chip runner: returns (run, init, drain) where
       run(carry, key) -> (carry', stats [cohorts_per_block, N_STATS]
                           psummed across the mesh)
       init(state)     -> carry with two bootstrap cohorts per device
       drain(carry)    -> (state, stats [2, N_STATS]) flushing pipelines
-    """
+
+    ``use_pallas``: None = honor DINT_USE_PALLAS env; the per-device
+    pipe_step then runs the DMA-ring kernels on ITS shard's local arrays
+    (shard_map bodies see local shapes, so the kernels drop straight in).
+    The availability probe runs once outside shard_map; Mosaic failure
+    falls back to the XLA path with a logged warning."""
     assert 2 * w <= (1 << td.K_ARB), f"w={w} exceeds the arb slot field"
+    use_pallas = pg.resolve_use_pallas(
+        use_pallas, n_idx=2 * w * td.K, m_lock=2 * w, k_arb=td.K_ARB)
     n_loc = n_sub_local(n_sub_global, n_shards)
     n1 = td.n_rows(n_loc) + 1
-    kw = dict(w=w, n_sub=n_loc, val_words=val_words)
+    kw = dict(w=w, n_sub=n_loc, val_words=val_words,
+              use_pallas=use_pallas)
 
     def local_step(state, c1, c2, key, gen_new=True):
         dev = jax.lax.axis_index(SHARD_AXIS)
@@ -159,13 +169,9 @@ def build_sharded_pipelined_runner(mesh: Mesh, n_shards: int,
         state = state.replace(db=db)
         # constants born inside the body (attempted, ab_validate=0) are
         # unvarying over the mesh axis; mark them varying so the scan
-        # carry types close under shard_map
-        def vary(x):
-            if SHARD_AXIS in getattr(jax.typeof(x), "vma", ()):
-                return x
-            return jax.lax.pcast(x, SHARD_AXIS, to="varying")
-
-        new_ctx, c1 = jax.tree.map(vary, (new_ctx, c1))
+        # carry types close under shard_map (identity on older jax)
+        new_ctx, c1 = jax.tree.map(
+            lambda x: pcast_varying(x, SHARD_AXIS), (new_ctx, c1))
         # CommitBck + CommitLog fan-out: forward installs to d+1, d+2
         for off in (1, 2):
             perm = [(i, (i + off) % n_shards) for i in range(n_shards)]

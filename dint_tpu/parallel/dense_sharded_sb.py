@@ -63,8 +63,9 @@ from ..engines.smallbank_pipeline import (L, TS_AMT_MAX, VW, N_STATS,
                                           STAT_BAL_DELTA, compute_phase,
                                           gen_cohort, _lock_slots)
 from ..engines.types import Op
+from ..ops import pallas_gather as pg
 from ..tables import log as logring
-from .sharded import SHARD_AXIS, make_mesh   # noqa: F401 (re-exported)
+from .sharded import SHARD_AXIS, make_mesh, pcast_varying   # noqa: F401 (re-exported)
 
 I32 = jnp.int32
 U32 = jnp.uint32
@@ -185,15 +186,23 @@ def _stats_of(c: SBCtx):
 
 def build_sharded_sb_runner(mesh: Mesh, n_shards: int, n_accounts: int,
                             w: int = 2048, cohorts_per_block: int = 8,
-                            hot_frac=None, hot_prob=None, mix=None):
+                            hot_frac=None, hot_prob=None, mix=None,
+                            use_pallas=None):
     """jit(shard_map(scan(step))). Contract mirrors the single-chip dense
-    runner: (run, init, drain); stats are psummed across the mesh."""
+    runner: (run, init, drain); stats are psummed across the mesh.
+
+    ``use_pallas``: None = honor DINT_USE_PALLAS env; routes the owner-side
+    held-stamp and balance gathers through the DMA-ring kernel
+    (ops/pallas_gather.gather_rows) on each device's local arrays; Mosaic
+    failure falls back to the XLA gathers (logged warning)."""
     d = n_shards
     n_loc = n_acct_local(n_accounts, d)
     m1 = m1_local(n_accounts, d)
     sent = m1 - 1
     oob = m1
     cap = 2 * ((w * L + d - 1) // d)
+    use_pallas = pg.resolve_use_pallas(use_pallas, n_idx=d * cap,
+                                       m_lock=None)
     kw_gen = {}
     if hot_frac is not None:
         kw_gen["hot_frac"] = hot_frac
@@ -239,8 +248,12 @@ def build_sharded_sb_runner(mesh: Mesh, n_shards: int, n_accounts: int,
             jnp.where(is_x, rows, oob)].min(lanes, mode="drop")
         first_s = jnp.full((m1,), BIG, I32).at[
             jnp.where(is_s, rows, oob)].min(lanes, mode="drop")
-        held_x = state.x_step[rows] == t - 1
-        held_s = state.s_step[rows] == t - 1
+        if use_pallas:
+            held_x = pg.gather_rows(state.x_step, rows, 1) == t - 1
+            held_s = pg.gather_rows(state.s_step, rows, 1) == t - 1
+        else:
+            held_x = state.x_step[rows] == t - 1
+            held_s = state.s_step[rows] == t - 1
         slot_free = ~held_x & ~held_s
         x_wins = (first_x[rows] < first_s[rows]) & slot_free
         grant_x = is_x & x_wins & (first_x[rows] == lanes)
@@ -250,8 +263,9 @@ def build_sharded_sb_runner(mesh: Mesh, n_shards: int, n_accounts: int,
         s_step = state.s_step.at[
             jnp.where(grant_s & (first_s[rows] == lanes), rows, oob)].set(
             t, mode="drop", unique_indices=True)
-        g_bal = jnp.where(grant_x | grant_s,
-                          state.bal[rows].astype(I32), 0)
+        raw_bal = (pg.gather_rows(state.bal, rows, 1) if use_pallas
+                   else state.bal[rows])
+        g_bal = jnp.where(grant_x | grant_s, raw_bal.astype(I32), 0)
 
         # ---- replies back to sources + classify -----------------------
         rep_g = _a2a((grant_x | grant_s), d, cap)
@@ -338,12 +352,7 @@ def build_sharded_sb_runner(mesh: Mesh, n_shards: int, n_accounts: int,
         state = state.replace(bal=bal_new, bck_bal=bck, x_step=x_step,
                               s_step=s_step, step=t + 1, log=log)
 
-        def vary(x):
-            if AXIS in getattr(jax.typeof(x), "vma", ()):
-                return x
-            return jax.lax.pcast(x, AXIS, to="varying")
-
-        new_ctx = jax.tree.map(vary, new_ctx)
+        new_ctx = jax.tree.map(lambda x: pcast_varying(x, AXIS), new_ctx)
         return state, new_ctx, jax.lax.psum(_stats_of(c1), AXIS)
 
     def scan_fn(carry, key, gen_new=True):

@@ -53,6 +53,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..engines import tatp_dense as td
 from ..tables import log as logring
 from .dense_sharded import (N_BCK, ShardState, _apply_backup, n_sub_local)
+from .sharded import pcast_varying
 
 I32 = jnp.int32
 U32 = jnp.uint32
@@ -136,14 +137,8 @@ def build_multihost_runner(mesh: Mesh, n_sub_global: int, w: int = 4096,
             gen_new=gen_new, emit_installs=True, **kw)
         state = state.replace(db=db)
 
-        def vary(x):
-            vma = getattr(jax.typeof(x), "vma", ())
-            for ax in (DCN_AXIS, ICI_AXIS):
-                if ax not in vma:
-                    x = jax.lax.pcast(x, ax, to="varying")
-            return x
-
-        new_ctx, c1 = jax.tree.map(vary, (new_ctx, c1))
+        new_ctx, c1 = jax.tree.map(
+            lambda x: pcast_varying(x, DCN_AXIS, ICI_AXIS), (new_ctx, c1))
         # CommitBck + CommitLog fan-out: forward installs to hosts h+1,
         # h+2 at the same chip — the only DCN traffic in the program
         for off in (1, 2):

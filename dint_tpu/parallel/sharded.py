@@ -46,6 +46,21 @@ U32 = jnp.uint32
 N_ROLES = 3
 SHARD_AXIS = "shard"
 
+
+def pcast_varying(x, *axes):
+    """`jax.lax.pcast(x, axis, to="varying")` for each axis the value is
+    not already varying over — needed under the new shard_map typing when
+    constants born inside the body must close a scan carry. On older jax
+    (0.4.37: no `lax.pcast`, no `jax.typeof`) shard_map tracks replication
+    itself and the cast is an identity."""
+    if not hasattr(jax.lax, "pcast"):
+        return x
+    vma = getattr(jax.typeof(x), "vma", ())
+    for ax in axes:
+        if ax not in vma:
+            x = jax.lax.pcast(x, ax, to="varying")
+    return x
+
 # engine registry: step fn + how many leading table ids are dense (and so
 # need the device-local row remap). Any engine whose step is a pure
 # (state, Batch) -> (state, Replies) over dense-indexed tables can shard.

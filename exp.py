@@ -139,13 +139,35 @@ def _tatp_runner(n_sub, w, cpb, seed=0):
     import jax
 
     from dint_tpu.engines import tatp_dense as td
+    from dint_tpu.ops import pallas_gather as pg
 
-    # on-device populate: the full sweep runs at the reference's 7M
-    # subscribers (~6.2 GB) — generated in HBM, not pushed via the host
-    db = td.populate_device(jax.random.PRNGKey(seed), n_sub, val_words=10)
-    run, init, drain = td.build_pipelined_runner(n_sub, w=w, val_words=10,
-                                                 cohorts_per_block=cpb)
-    return run, init(db), drain
+    use_pallas = pg.resolve_use_pallas(None, n_idx=2 * w * td.K,
+                                       m_lock=2 * w, k_arb=td.K_ARB)
+
+    def build(up):
+        # on-device populate: the full sweep runs at the reference's 7M
+        # subscribers (~6.2 GB) — generated in HBM, not via the host
+        db = td.populate_device(jax.random.PRNGKey(seed), n_sub,
+                                val_words=10)
+        run, init, drain = td.build_pipelined_runner(
+            n_sub, w=w, val_words=10, cohorts_per_block=cpb, use_pallas=up)
+        carry = init(db)
+        if up:
+            # force the full-geometry compile NOW: a Mosaic failure the
+            # small-table probe missed must degrade to the XLA path here,
+            # not void the sweep point (run donates carry -> rebuild)
+            carry, s = run(carry, jax.random.PRNGKey(seed + 7))
+            np.asarray(s)
+        return run, carry, drain
+
+    try:
+        return build(use_pallas)
+    except Exception as e:
+        if not use_pallas:
+            raise
+        print("pallas kernel path failed at full geometry; XLA fallback: "
+              f"{e!r}"[:300], flush=True)
+        return build(False)
 
 
 def _tatp_extras(total):
@@ -163,12 +185,32 @@ def _tatp_extras(total):
 
 
 def _sb_runner(n_acc, w, cpb):
-    from dint_tpu.engines import smallbank_dense as sd
+    import jax
 
-    db = sd.create(n_acc)
-    run, init, drain = sd.build_pipelined_runner(n_acc, w=w,
-                                                 cohorts_per_block=cpb)
-    return run, init(db), drain
+    from dint_tpu.engines import smallbank_dense as sd
+    from dint_tpu.ops import pallas_gather as pg
+
+    use_pallas = pg.resolve_use_pallas(None, n_idx=w * sd.L, m_lock=None)
+
+    def build(up):
+        db = sd.create(n_acc)
+        run, init, drain = sd.build_pipelined_runner(
+            n_acc, w=w, cohorts_per_block=cpb, use_pallas=up)
+        carry = init(db)
+        if up:
+            # same full-geometry degrade rule as _tatp_runner
+            carry, s = run(carry, jax.random.PRNGKey(13))
+            np.asarray(s)
+        return run, carry, drain
+
+    try:
+        return build(use_pallas)
+    except Exception as e:
+        if not use_pallas:
+            raise
+        print("pallas kernel path failed at full geometry; XLA fallback: "
+              f"{e!r}"[:300], flush=True)
+        return build(False)
 
 
 def _sb_extras(total):

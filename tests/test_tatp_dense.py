@@ -215,8 +215,11 @@ def test_matches_generic_pipelined_engine_at_low_contention():
     """Same seed -> same population + same cohorts; at low contention the
     dense engine must produce the exact same stats as the generic
     sort-based engine (engines/tatp_pipeline): exact CF locks only remove
-    hash-conflation conflicts, which are absent at this scale."""
-    n_sub, w, blocks, seed = 2000, 256, 2, 7
+    hash-conflation conflicts, so the seed must draw none. Seed 7 draws
+    exactly one (the generic engine conflates two CF keys into one lock
+    row and aborts a txn the dense engine correctly commits — seeds 0-3
+    draw zero); the test ran broken on that seed since the seed drop."""
+    n_sub, w, blocks, seed = 2000, 256, 2, 0
 
     db = td.populate(np.random.default_rng(seed), n_sub, val_words=VW)
     run_d, init_d, drain_d = td.build_pipelined_runner(

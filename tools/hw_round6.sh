@@ -1,0 +1,35 @@
+#!/bin/bash
+# Round-6 hardware measurement plan: the Pallas DMA-ring kernel A/B
+# (ISSUE 1 tentpole). Outage-aware like hw_round5.sh: wait for the tunnel,
+# then land the cheapest decisive artifact first — the per-op microbench
+# settles whether the ring beats XLA's gather per op, the bench pair
+# settles what that buys end-to-end at n_sub=7e6.
+cd "$(dirname "$0")/.." || exit 1
+
+echo "=== stage 0: wait for the tunnel ==="
+for i in $(seq 1 200); do
+    if timeout 60 python -c "import jax; print(float(jax.numpy.ones(2).sum()))" \
+            > /dev/null 2>&1; then
+        echo "backend reachable (attempt $i)"
+        break
+    fi
+    echo "unreachable (attempt $i); sleeping 120s"
+    sleep 120
+done
+
+echo "=== stage 1: per-op A/B microbench (meta + val geometry + lock pass) ==="
+timeout 1500 python tools/profile_pallas_hbm.py --compare \
+    > pallas_ab.log 2>&1 || true
+tail -3 pallas_ab.log
+
+echo "=== stage 2: XLA baseline bench (profile) ==="
+DINT_BENCH_PROFILE=1 timeout 2200 python bench.py \
+    > bench_xla.json 2> bench_xla_stderr.log
+tail -1 bench_xla.json
+
+echo "=== stage 3: pallas-path bench (profile) — the tentpole measurement ==="
+DINT_USE_PALLAS=1 DINT_BENCH_PROFILE=1 timeout 2200 python bench.py \
+    > bench_pallas.json 2> bench_pallas_stderr.log
+tail -1 bench_pallas.json
+
+echo "=== done ==="

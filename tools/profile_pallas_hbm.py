@@ -1,31 +1,41 @@
-"""Pallas probe: random-row gather from an HBM-resident table via a ring
-of outstanding async DMAs, vs XLA's gather.
+"""Pallas DMA-ring vs XLA random-access A/B microbench (HBM tables).
 
-Round-3 blocked the Pallas route on VMEM-resident tables (Mosaic rejects
+Round 3 blocked the Pallas route on VMEM-resident tables (Mosaic rejects
 scalar VMEM stores; tools/profile_pallas.py). At reference scale the
-tables are HBM-resident anyway (6.2 GB val / 0.6 GB meta), so the
-relevant primitive is different: K random row reads from HBM. XLA's
-gather costs ~0.5-2 ms per 16-32k indices on this chip (PERF.md); if a
-Pallas kernel holding NSLOTS DMAs in flight beats that, the wave-1 /
-validate / magic chain is worth fusing into one kernel.
+tables are HBM-resident anyway (6.2 GB val / 0.6 GB meta), so the relevant
+primitive is K random row reads from HBM — and since round 6 the
+PRODUCTION kernels live in dint_tpu/ops/pallas_gather.py (this tool used
+to carry its own copy; it now measures exactly what the engines run behind
+DINT_USE_PALLAS=1).
 
-Layout matches production (engines/tatp_dense.DenseDB.val): a tight
-interleaved 1-D word array, row r at [r*VW, (r+1)*VW) — NOT [N, VW],
-which TPU tiling pads 12.8x.
+Two modes:
 
-Design: indices are prefetched to SMEM (PrefetchScalarGridSpec), the
-kernel walks them with a fori_loop keeping NSLOTS row-DMAs outstanding
-(slot i%NSLOTS waits before reuse), each DMA copying one VW-word row
-HBM->VMEM output.
+* probe mode (default): one geometry, XLA gather vs `gather_rows`, human-
+  readable timings. N now defaults to the VAL-SCALE row count (the full
+  22*(n_sub+1) flat row space at the reference's n_sub=7e6 — 6.2 GB at
+  VW=10): the round-5 advisor flagged that the old 0.6 GB default measured
+  META-scale DMA behaviour only, and a speedup measured there must not be
+  generalized to the 10x larger val table. The geometry is printed either
+  way so no number can be misread.
 
-Usage: python tools/profile_pallas_hbm.py [K] [N_rows] [VW] [--interpret]
+* `--compare`: the A/B matrix the next tunnel window records — both
+  backends at BOTH production geometries (meta: VW=1, 0.6 GB; val: VW=10,
+  6.2 GB; same row count, the real arrays' shapes) plus the fused
+  lock-pass kernel vs its 3-op XLA chain on the meta-scale arb array.
+  Emits ONE machine-parseable JSON line (artifact convention of bench.py).
 
---interpret runs the kernel in pallas interpret mode (CPU-safe): this
-reproduces the semantics validation (outputs equal XLA's gather at
-K=256/N=10k), so a TPU failure is a Mosaic/compile issue, not logic.
+Usage: python tools/profile_pallas_hbm.py [K] [N_rows] [VW]
+           [--interpret] [--compare]
+
+--interpret runs the kernels in pallas interpret mode (CPU-safe) at scaled-
+down geometry: this reproduces the semantics validation (outputs equal
+XLA's gather bit for bit), so a TPU failure is a Mosaic/compile issue, not
+logic. Interpret-mode timings measure the INTERPRETER, not the hardware —
+the JSON line says so.
 """
 from __future__ import annotations
 
+import json
 import os
 import sys
 import time
@@ -33,80 +43,35 @@ import time
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
 plat = os.environ.get("JAX_PLATFORMS")
 if plat:
     jax.config.update("jax_platforms", plat)
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dint_tpu.ops import pallas_gather as pg          # noqa: E402
+
+# the reference's full flat row space: 22*(n_sub+1)+1 rows at n_sub=7e6
+# (engines/tatp_dense.n_rows) — the row count of BOTH meta (VW=1, 0.6 GB)
+# and val (VW=10, 6.2 GB)
+VAL_SCALE_ROWS = 22 * (7_000_000 + 1) + 1
+
 INTERPRET = "--interpret" in sys.argv
-argv = [a for a in sys.argv if a != "--interpret"]
+COMPARE = "--compare" in sys.argv
+argv = [a for a in sys.argv if not a.startswith("--")]
 K = int(argv[1]) if len(argv) > 1 else (256 if INTERPRET else 32_768)
-N = int(argv[2]) if len(argv) > 2 else (10_000 if INTERPRET else 15_400_002)
+N = int(argv[2]) if len(argv) > 2 else (10_000 if INTERPRET
+                                        else VAL_SCALE_ROWS)
 VW = int(argv[3]) if len(argv) > 3 else 10
-NSLOTS = 16
-ITERS = 8
+ITERS = 2 if INTERPRET else 8
+K_ARB = 18
 
 
-def gather_kernel(idx_ref, tab_ref, out_ref, sem):
-    """idx_ref: SMEM [K] i32 (prefetched row ids); tab_ref: HBM [N*VW]
-    u32; out_ref: [K*VW] u32; sem: DMA sems [NSLOTS]."""
-
-    def start(i):
-        r = idx_ref[i]
-        return pltpu.make_async_copy(
-            tab_ref.at[pl.ds(r * VW, VW)],
-            out_ref.at[pl.ds(i * VW, VW)],
-            sem.at[i % NSLOTS])
-
-    def prime(i, _):
-        start(i).start()
-        return 0
-
-    jax.lax.fori_loop(0, min(NSLOTS, K), prime, 0)
-
-    def body(i, _):
-        start(i).wait()          # slot free again
-
-        def issue(_):
-            start(i + NSLOTS).start()
-            return 0
-
-        jax.lax.cond(i + NSLOTS < K, issue, lambda _: 0, 0)
-        return 0
-
-    jax.lax.fori_loop(0, K, body, 0)
-
-
-@jax.jit
-def pallas_gather(tab, idx):
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(1,),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
-        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
-        scratch_shapes=[pltpu.SemaphoreType.DMA((NSLOTS,))],
-    )
-    return pl.pallas_call(
-        gather_kernel,
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((K * VW,), jnp.uint32),
-        interpret=INTERPRET,
-    )(idx, tab)
-
-
-@jax.jit
-def xla_gather(tab, idx):
-    # production access pattern (tatp_dense.pipe_step wave-1 val reads)
-    flat = (idx[:, None] * VW + jnp.arange(VW, dtype=jnp.int32)).reshape(-1)
-    return tab[flat]
-
-
-def timeit(name, fn, *args, reps=3):
+def timeit(name, fn, *args, reps=3, count=None):
     try:
         out = fn(*args)
-        np.asarray(out[:8])
+        np.asarray(jax.tree.leaves(out)[0][:8])
     except Exception as e:
         print(f"{name:24s} FAILED: {repr(e)[:300]}", flush=True)
         return None
@@ -115,27 +80,123 @@ def timeit(name, fn, *args, reps=3):
         t0 = time.perf_counter()
         for _ in range(ITERS):
             out = fn(*args)
-        np.asarray(out[:8])
+        np.asarray(jax.tree.leaves(out)[0][:8])
         best = min(best, (time.perf_counter() - t0) / ITERS)
-    print(f"{name:24s} {best * 1e3:8.3f} ms per {K} rows", flush=True)
+    print(f"{name:24s} {best * 1e3:8.3f} ms per {count or K} indices",
+          flush=True)
     return best
+
+
+def xla_gather(tab, idx, vw):
+    # production access pattern (tatp_dense.pipe_step wave-1 val reads)
+    flat = (idx[:, None] * vw + jnp.arange(vw, dtype=jnp.int32)).reshape(-1)
+    return tab[flat]
+
+
+def xla_lock_chain(arb, rows, active, t):
+    """The 3-op chain the fused kernel replaces (tatp_dense.pipe_step)."""
+    m = rows.shape[0]
+    oob = arb.shape[0]
+    old = arb[rows]
+    held = (old >> K_ARB) == (t - 1)
+    packed = (t << K_ARB) | (jnp.uint32(m - 1)
+                             - jnp.arange(m, dtype=jnp.uint32))
+    cand = active & ~held
+    arb2 = arb.at[jnp.where(cand, rows, oob)].max(packed, mode="drop")
+    grant = cand & (arb2[rows] == packed)
+    return arb2, grant
+
+
+def ab_point(rng, n, vw, k):
+    """One geometry: build the table, time XLA vs pallas, cross-check."""
+    tab = jnp.asarray(rng.integers(0, 1 << 30, n * vw, np.int64)
+                      .astype(np.uint32))
+    idx = jnp.asarray(rng.integers(0, n, k).astype(np.int32))
+    gb = n * vw * 4 / 1e9
+    print(f"--- table [{n}*{vw}] u32 = {gb:.2f} GB, K={k} ---", flush=True)
+    jit_x = jax.jit(xla_gather, static_argnums=2)
+    x = timeit("xla gather", jit_x, tab, idx, vw, count=k)
+    p = timeit("pallas dma-ring gather", pg.gather_rows, tab, idx, vw,
+               count=k)
+    equal = None
+    if x and p:
+        a = np.asarray(jit_x(tab, idx, vw))
+        b = np.asarray(pg.gather_rows(tab, idx, vw))
+        equal = bool(np.array_equal(a, b))
+        print(f"outputs equal: {equal}   speedup: {x / p:.2f}x", flush=True)
+    return {
+        "rows": n, "vw": vw, "gb": round(gb, 3),
+        "xla_ms": None if x is None else round(x * 1e3, 3),
+        "pallas_ms": None if p is None else round(p * 1e3, 3),
+        "speedup": None if not (x and p) else round(x / p, 2),
+        "equal": equal,
+    }
+
+
+def ab_lock(rng, n, m):
+    """Fused lock pass vs the XLA 3-op chain on a meta-scale arb array.
+    Both sides rebuild from the same base array each call; the delta is
+    the chain cost (the copy cost is shared)."""
+    arb = jnp.zeros((n + 1,), jnp.uint32)
+    rows = jnp.asarray(rng.integers(0, n, m).astype(np.int32))
+    act = jnp.asarray(rng.random(m) < 0.9)
+    t = jnp.asarray(5, jnp.uint32)
+    print(f"--- lock pass: arb [{n + 1}] u32, M={m} lanes ---", flush=True)
+    jit_x = jax.jit(xla_lock_chain)
+    x = timeit("xla 3-op lock chain", jit_x, arb, rows, act, t, count=m)
+    p = timeit("pallas fused lock pass",
+               lambda a, r, ac, tt: pg.lock_arbitrate(jnp.array(a), r, ac,
+                                                      tt, K_ARB),
+               arb, rows, act, t, count=m)
+    equal = None
+    if x and p:
+        a2, g = jit_x(arb, rows, act, t)
+        b2, gp = pg.lock_arbitrate(jnp.array(arb), rows, act, t, K_ARB)
+        equal = bool(np.array_equal(np.asarray(a2), np.asarray(b2))
+                     and np.array_equal(np.asarray(g),
+                                        np.asarray(gp != 0)))
+        print(f"outputs equal: {equal}   speedup: {x / p:.2f}x", flush=True)
+    return {
+        "lanes": m,
+        "xla_ms": None if x is None else round(x * 1e3, 3),
+        "pallas_ms": None if p is None else round(p * 1e3, 3),
+        "speedup": None if not (x and p) else round(x / p, 2),
+        "equal": equal,
+    }
 
 
 def main():
     rng = np.random.default_rng(0)
-    tab = jnp.asarray(rng.integers(0, 1 << 30, N * VW, np.int64)
-                      .astype(np.uint32))
-    idx = jnp.asarray(rng.integers(0, N, K).astype(np.int32))
-    print(f"table [{N}*{VW}] u32 = {N * VW * 4 / 1e9:.2f} GB, "
-          f"K={K}, NSLOTS={NSLOTS}")
-    x = timeit("xla gather", xla_gather, tab, idx)
-    p = timeit("pallas dma-ring gather", pallas_gather, tab, idx)
-    if x and p:
-        # correctness cross-check before believing any speedup
-        a = np.asarray(xla_gather(tab, idx))
-        b = np.asarray(pallas_gather(tab, idx))
-        print("outputs equal:", bool(np.array_equal(a, b)))
-        print(f"speedup: {x / p:.2f}x")
+    if COMPARE:
+        # interpret mode (CPU) cannot hold / cannot afford the real
+        # geometries: scale rows down but keep the vw structure, and say so
+        rows = 100_000 if INTERPRET else VAL_SCALE_ROWS
+        k = 256 if INTERPRET else K
+        m = 128 if INTERPRET else 16_384      # 2*w at the bench's w=8192
+        if INTERPRET:
+            print(f"[interpret mode: geometry scaled to {rows} rows — "
+                  "timings measure the interpreter, not hardware]",
+                  flush=True)
+        out = {
+            "metric": "pallas_gather_ab",
+            "k": k,
+            "interpret": INTERPRET,
+            "backend": jax.default_backend(),
+            "meta": ab_point(rng, rows, 1, k),
+            "val": ab_point(rng, rows, VW, k),
+            "lock": ab_lock(rng, rows, m),
+        }
+        print(json.dumps(out), flush=True)
+        return
+
+    if N == VAL_SCALE_ROWS and VW == 10:
+        print("probing at VAL scale (6.2 GB); pass N_rows to override "
+              "(the old default probed meta scale, 0.6 GB)", flush=True)
+    else:
+        print(f"probing at {N * VW * 4 / 1e9:.2f} GB — NOT the 6.2 GB "
+              "val-scale geometry; do not generalize this speedup",
+              flush=True)
+    ab_point(rng, N, VW, K)
 
 
 if __name__ == "__main__":
