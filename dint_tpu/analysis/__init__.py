@@ -1,0 +1,99 @@
+"""dintlint: jaxpr-level static analysis of the engine hot paths.
+
+The server hot path's correctness rests on invariants no test exercises
+deterministically — conflict-free scatters (one writer per row), dead
+donated buffers, pure single-dispatch steps, uint32 stamp arithmetic, and
+mesh-consistent collectives. This package traces every registered
+engine/sharded step function with abstract values (CPU, no device) and
+walks the jaxprs with a registry of passes, each encoding one invariant;
+`tools/dintlint.py` is the CLI and `tests/test_dintlint.py` the tier-1
+gate. The pass catalogue and how to extend it live in ANALYSIS.md.
+
+Library API:
+
+    from dint_tpu import analysis
+    findings = analysis.run()                       # all targets, passes
+    findings = analysis.run(targets=["tatp_dense/block"],
+                            passes=["scatter_race"],
+                            allowlist_path="tools/dintlint_allow.json")
+    analysis.has_errors(findings)                   # -> CLI exit code
+"""
+from __future__ import annotations
+
+from . import passes as _passes          # noqa: F401 — registers the passes
+from . import allowlist as _allowlist
+from .core import (Finding, PASS_DOCS, PASSES, SEV_ERROR, SEV_INFO,  # noqa: F401
+                   SEV_WARNING, TargetTrace, trace_target)
+from .targets import TARGET_DOCS, TARGETS, SkipTarget, get_trace  # noqa: F401
+
+
+def run(targets=None, passes=None, allowlist_path: str | None = None,
+        allowlist_entries=None) -> list[Finding]:
+    """Trace the requested targets, run the requested passes, apply the
+    allowlist. Unknown names raise KeyError (the CLI turns that into a
+    usage error); a target whose prerequisites are missing (device count)
+    yields one INFO finding instead of failing the run."""
+    target_names = list(targets) if targets else list(TARGETS)
+    pass_names = list(passes) if passes else list(PASSES)
+    for name in target_names:
+        if name not in TARGETS:
+            raise KeyError(f"unknown target {name!r}; known: "
+                           f"{sorted(TARGETS)}")
+    for name in pass_names:
+        if name not in PASSES:
+            raise KeyError(f"unknown pass {name!r}; known: "
+                           f"{sorted(PASSES)}")
+
+    findings: list[Finding] = []
+    for tname in target_names:
+        try:
+            trace = get_trace(tname)
+        except SkipTarget as e:
+            findings.append(Finding(
+                "harness", "target-skipped", SEV_INFO, tname,
+                f"target skipped: {e}"))
+            continue
+        except Exception as e:      # noqa: BLE001 — a broken builder must
+            # not hide every other target's findings; it IS a gate failure
+            findings.append(Finding(
+                "harness", "target-build-failed", SEV_ERROR, tname,
+                f"target builder raised {type(e).__name__}: {e} — the "
+                "engine builder itself no longer runs at lint geometry",
+                suggestion="run the builder directly to reproduce; if the "
+                           "entry point moved, update "
+                           "dint_tpu/analysis/targets.py"))
+            continue
+        for pname in pass_names:
+            findings.extend(PASSES[pname](trace))
+    findings = _dedup(findings)
+
+    entries = list(allowlist_entries) if allowlist_entries else []
+    if allowlist_path:
+        entries += _allowlist.load(allowlist_path)
+    findings = _allowlist.apply(
+        findings, entries,
+        check_unused=targets is None and passes is None)
+    findings.sort(key=lambda f: f.sort_key())
+    return findings
+
+
+def _dedup(findings: list[Finding]) -> list[Finding]:
+    """Merge identical findings (one source line traced many times — scan
+    bodies, vmapped replicas) into one carrying a count: the report should
+    scale with distinct problems, not with trace multiplicity."""
+    merged: dict[tuple, Finding] = {}
+    for f in findings:
+        k = (f.pass_name, f.code, f.severity, f.target, f.primitive,
+             f.site, f.path, f.message)
+        if k in merged:
+            merged[k].count += 1
+        else:
+            merged[k] = f
+    return list(merged.values())
+
+
+def has_errors(findings) -> bool:
+    """True if any unsuppressed error-severity finding remains — the CLI's
+    nonzero-exit condition and the CI gate's assertion."""
+    return any(f.severity == SEV_ERROR and not f.suppressed
+               for f in findings)

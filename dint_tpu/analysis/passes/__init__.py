@@ -1,0 +1,17 @@
+"""dintlint pass registry: importing this package registers every pass.
+
+Each module encodes ONE invariant of the engine/sharded hot paths as an
+eqn-level predicate over the traced jaxpr (see analysis/core.py for the
+walking machinery and ANALYSIS.md for the invariant catalogue):
+
+  scatter_race       one writer per table row, provably
+  aliasing           donated / input_output_aliased buffers are dead
+  purity             a step is one pure device program
+  u64_overflow       packed stamps stay unsigned 32-bit
+  shard_consistency  collectives agree with the mesh
+
+Adding a pass: write `passes/<name>.py`, decorate the entry point with
+`@core.register_pass("<name>")`, import it here.
+"""
+from . import (aliasing, purity, scatter_race, shard_consistency,  # noqa: F401
+               u64_overflow)

@@ -1,0 +1,99 @@
+"""Scatter-race pass: every table scatter must be provably conflict-free.
+
+The dense engines' whole determinism argument (engines/tatp_dense.py
+"Scatter discipline") is that a scatter with duplicate indices is a race:
+XLA leaves the winner unspecified for overwrite scatters, and even
+order-independent reducers (add on floats) pick up nondeterministic
+rounding. The repo's discipline is (a) certify one writer per row and say
+so with ``unique_indices=True`` + masked lanes routed out of bounds under
+``mode="drop"``, or (b) derive the scatter mask from the segment machinery
+(ops/segments.sort_batch head/last masks), whose sorted-key provenance this
+pass recognizes in the index def-chain.
+
+Severity ladder:
+  * overwrite scatter (`scatter`) with no uniqueness evidence -> ERROR:
+    the installed value is nondeterministic under duplicates.
+  * float add/mul reducer with no evidence -> ERROR: value depends on
+    reduction order (rounding).
+  * integer add/max/min reducer with no evidence -> INFO: the value is
+    order-independent (this is the engines' deliberate scatter-max
+    arbitration pattern) but duplicates serialize on TPU, so the eqn is
+    surfaced for perf review, not failed.
+  * any scatter with operand_batching_dims -> WARNING: a vmapped scatter
+    lowers to a serialized per-batch loop on TPU (the round-3 finding that
+    motivated the dense redesign).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core import (Finding, SEV_ERROR, SEV_INFO, SEV_WARNING, TargetTrace,
+                    def_chain_prims, register_pass, site_of, walk)
+
+SCATTER_PRIMS = {"scatter", "scatter-add", "scatter-mul", "scatter-max",
+                 "scatter-min"}
+# reducers whose result is independent of update order on exact (integer)
+# arithmetic; float add/mul are order-dependent through rounding
+_ORDER_FREE_INT = {"scatter-add", "scatter-max", "scatter-min"}
+# def-chain prims that prove the segment-representative discipline: indices
+# built from sorted keys + a head/last mask (ops/segments)
+_SEGMENT_EVIDENCE = {"sort"}
+
+
+def _is_float(aval) -> bool:
+    return jnp.issubdtype(aval.dtype, jnp.floating)
+
+
+@register_pass("scatter_race")
+def scatter_race(trace: TargetTrace) -> list[Finding]:
+    """Flags scatters whose index operands are not provably conflict-free."""
+    out: list[Finding] = []
+    for ctx in walk(trace):
+        if ctx.prim not in SCATTER_PRIMS or ctx.in_pallas_kernel:
+            continue
+        eqn = ctx.eqn
+        dn = eqn.params.get("dimension_numbers")
+        if dn is not None and getattr(dn, "operand_batching_dims", ()):
+            out.append(Finding(
+                "scatter_race", "batched-scatter", SEV_WARNING, trace.name,
+                "vmapped/batched scatter serializes per batch element on "
+                "TPU (round-3 measurement); restructure to a flat 1-D "
+                "scatter over a combined index space",
+                primitive=ctx.prim, site=site_of(eqn),
+                path="/".join(ctx.path)))
+        if eqn.params.get("unique_indices"):
+            continue
+        # evidence hunt: indices derived from the segment sort machinery
+        idx_var = eqn.invars[1] if len(eqn.invars) > 1 else None
+        chain = (def_chain_prims(ctx.jaxpr, idx_var, ctx.index)
+                 if idx_var is not None else set())
+        if chain & _SEGMENT_EVIDENCE:
+            continue    # segment-head-masked: one writer by construction
+        operand_aval = eqn.invars[0].aval
+        if ctx.prim == "scatter" or (ctx.prim in ("scatter-add",
+                                                  "scatter-mul")
+                                     and _is_float(operand_aval)):
+            out.append(Finding(
+                "scatter_race", "nonunique-" + ctx.prim, SEV_ERROR,
+                trace.name,
+                f"`{ctx.prim}` with unique_indices=False and indices not "
+                "derived from a segment-head mask: duplicate rows make the "
+                "result nondeterministic "
+                + ("(unspecified winner)" if ctx.prim == "scatter"
+                   else "(float reduction order)"),
+                primitive=ctx.prim, site=site_of(eqn),
+                path="/".join(ctx.path),
+                suggestion="certify one writer per row and pass "
+                           "unique_indices=True with masked lanes routed "
+                           "out of bounds under mode='drop' (see "
+                           "ops/segments.scatter_rows), or resolve "
+                           "duplicates with the segment machinery first"))
+        elif ctx.prim in _ORDER_FREE_INT:
+            out.append(Finding(
+                "scatter_race", "reducer-dup", SEV_INFO, trace.name,
+                f"`{ctx.prim}` without unique_indices: result is "
+                "order-independent on integers (the deliberate scatter-max "
+                "arbitration pattern) but duplicate rows serialize on TPU",
+                primitive=ctx.prim, site=site_of(eqn),
+                path="/".join(ctx.path)))
+    return out

@@ -1,0 +1,122 @@
+"""u64-emulation overflow pass: packed stamps must stay unsigned 32-bit.
+
+TPU device code has no native u64: 64-bit keys ride as (hi, lo) uint32
+pairs (ops/u64.py) and lock/version stamps pack `step << K | slot` into
+one uint32 (engines/tatp_dense.K_ARB layout, smallbank_dense x/s stamps).
+The arithmetic is correct exactly as long as it stays in uint32: a silent
+reinterpretation to int32 flips the sign of any stamp with the top bit
+set — `step >= 2^(31-K)` — and every `<`/`>=` stamp compare after that
+point is wrong for half the step space. That is a bug that appears only
+after ~8k steps at K_ARB=18, i.e. never in a smoke test and always in a
+long benchmark run (the rebase machinery in tatp_dense.rebase_stamps
+exists precisely because the stamp field is finite).
+
+What counts as drift — and what deliberately does not:
+
+  * `convert_element_type` uint32 -> int32/int16/int8 whose operand's
+    def-chain contains a left shift (`shift_left`, the packed-stamp
+    construction) *with no range-limiting op in between* -> ERROR. The
+    chain CUTS at `and`/`rem`/`shift_right_logical`/division: a value
+    masked to `& (n-1)` or reduced `% cap` before the convert has
+    provably lost its high bits — that admits the repo's two benign
+    idioms (hash -> mask -> int32 bucket index in ops/hashing.py, ring
+    position `% cap` -> int32 slot in tables/log.py) while still catching
+    a raw `(step << K | lane).astype(int32)`.
+  * signed `lt`/`le`/`gt`/`ge` where an operand IS such a drifted convert
+    (its defining eqn, one hop back) -> ERROR: the compare orders stamps
+    by sign bit, not magnitude. One hop only — transitive chains would
+    re-flag every index compare downstream of a hash mix.
+  * any 64-bit integer aval in device code -> WARNING: x64 leaked in; the
+    engines' contract is (hi, lo) uint32 pairs so kernels stay on 32-bit
+    VPU lanes (ops/u64 module doc).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core import (Finding, SEV_ERROR, SEV_WARNING, TargetTrace,
+                    def_chain_prims, def_var, register_pass, site_of, walk)
+
+_NARROW_SIGNED = {jnp.dtype("int32"), jnp.dtype("int16"), jnp.dtype("int8")}
+_CMP = {"lt", "le", "gt", "ge"}
+_I64 = {jnp.dtype("int64"), jnp.dtype("uint64")}
+# ops whose output provably dropped its operands' magnitude: cut the
+# drift slice here (see module doc)
+_RANGE_LIMITING = frozenset({"and", "rem", "shift_right_logical",
+                             "shift_right_arithmetic", "div", "min",
+                             "reduce_min", "clamp"})
+
+
+def _dtype(var):
+    return getattr(var.aval, "dtype", None)
+
+
+def _is_drifted_convert(eqn, jaxpr, index) -> bool:
+    """True when `eqn` is a u32 -> narrow-signed convert of a value whose
+    unmasked def-chain carries a left shift (packed-stamp layout)."""
+    if eqn.primitive.name != "convert_element_type":
+        return False
+    src = _dtype(eqn.invars[0])
+    dst = eqn.params.get("new_dtype")
+    if src != jnp.dtype("uint32") or jnp.dtype(dst) not in _NARROW_SIGNED:
+        return False
+    chain = def_chain_prims(jaxpr, eqn.invars[0], index,
+                            stop=_RANGE_LIMITING)
+    return "shift_left" in chain
+
+
+@register_pass("u64_overflow")
+def u64_overflow(trace: TargetTrace) -> list[Finding]:
+    """Flags dtype drift in packed hi/lo uint32 stamp arithmetic (silent
+    int32 wraparound in stamp compares)."""
+    out: list[Finding] = []
+    for ctx in walk(trace):
+        eqn, site, path = ctx.eqn, site_of(ctx.eqn), "/".join(ctx.path)
+
+        if _is_drifted_convert(eqn, ctx.jaxpr, ctx.index):
+            dst = jnp.dtype(eqn.params.get("new_dtype")).name
+            out.append(Finding(
+                "u64_overflow", "stamp-sign-drift", SEV_ERROR, trace.name,
+                "uint32 value built with a left shift (packed stamp "
+                f"layout) converted to {dst} without masking first: "
+                "stamps with the top bit set reinterpret as negative and "
+                "every subsequent compare is wrong for half the step "
+                "space",
+                primitive=ctx.prim, site=site, path=path,
+                suggestion="keep stamp words uint32 end to end; convert "
+                           "only AFTER masking the packed field "
+                           "(x & ((1<<K)-1)) or shifting it down"))
+
+        elif ctx.prim in _CMP:
+            for v in eqn.invars:
+                if _dtype(v) not in _NARROW_SIGNED:
+                    continue
+                d = def_var(ctx.jaxpr, v, ctx.index)
+                if d is not None and _is_drifted_convert(d, ctx.jaxpr,
+                                                         ctx.index):
+                    out.append(Finding(
+                        "u64_overflow", "signed-stamp-compare", SEV_ERROR,
+                        trace.name,
+                        f"signed `{ctx.prim}` on an int-converted packed "
+                        "uint32 stamp: the compare orders by sign bit, "
+                        "not stamp magnitude",
+                        primitive=ctx.prim, site=site, path=path,
+                        suggestion="compare stamps as uint32 (see "
+                                   "ops/u64.lt for the 64-bit pair form)"))
+                    break
+
+        for v in list(eqn.invars) + list(eqn.outvars):
+            dt = _dtype(v)
+            if dt in _I64:
+                out.append(Finding(
+                    "u64_overflow", "i64-on-device", SEV_WARNING,
+                    trace.name,
+                    f"64-bit integer ({dt}) in device code: TPUs run "
+                    "32-bit lanes, so this either fails to lower or "
+                    "silently emulates; the repo contract is (hi, lo) "
+                    "uint32 pairs (ops/u64)",
+                    primitive=ctx.prim, site=site, path=path,
+                    suggestion="split the value with ops/u64.split and "
+                               "carry (hi, lo) uint32 arrays"))
+                break
+    return out

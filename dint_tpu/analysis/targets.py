@@ -1,0 +1,273 @@
+"""dintlint target registry: every engine/sharded step function we lint.
+
+A target is a named thunk that builds ONE hot-path function plus example
+arguments at tiny geometry and hands both to `core.trace_target`. State is
+constructed ABSTRACTLY (`jax.eval_shape` around the real builders, so the
+shapes can never drift from production code) and tracing uses abstract
+values only — no buffers, no device programs, the whole registry runs on
+CPU in seconds. The jaxpr of a w=16 step is the same eqn stream as the
+production w=8192 one.
+
+Coverage contract (ANALYSIS.md): every production entry point that bench.py
+or exp.py can dispatch appears here — both dense engines (XLA and Pallas
+routes), the dense pipeline drain, both generic fused pipelines, the
+generic replicated shard step, and both dense multi-chip runners. The
+Pallas variants force ``use_pallas=True`` so the aliasing pass sees real
+``pallas_call`` input_output_aliases; on CPU the kernels trace in
+interpret mode (ops/pallas_gather.use_interpret).
+
+Mesh targets need >= `_MESH_SHARDS` devices; the dintlint CLI forces an
+8-device virtual CPU topology exactly like tests/conftest.py, and targets
+raise `SkipTarget` (reported, never fatal) when the topology cannot host
+them.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .core import TargetTrace, trace_target
+
+U32 = jnp.uint32
+
+_MESH_SHARDS = 4
+# tiny-geometry knobs shared by all builders: shapes don't change the eqn
+# stream, only trace time and memory
+_N_SUB = 32
+_N_ACCT = 64
+_W = 16
+_BLK = 2
+_VW = 4
+_LOGCAP = 128
+
+TARGETS: dict[str, Callable[[], TargetTrace]] = {}
+TARGET_DOCS: dict[str, str] = {}
+
+
+class SkipTarget(Exception):
+    """Raised by a builder whose prerequisites (device count) are absent."""
+
+
+def register_target(name: str, doc: str):
+    def deco(fn):
+        TARGETS[name] = fn
+        TARGET_DOCS[name] = doc
+        return fn
+    return deco
+
+
+def _abstract(thunk):
+    """Run a state builder under eval_shape: the production constructor
+    defines the shapes, but no buffer is allocated and no device program
+    runs (device_put inside the builders becomes a no-op on tracers)."""
+    return jax.eval_shape(thunk)
+
+
+def _key_aval():
+    return jax.ShapeDtypeStruct((2,), jnp.uint32)
+
+
+def _mesh(n: int):
+    if len(jax.devices()) < n:
+        raise SkipTarget(
+            f"needs {n} devices, have {len(jax.devices())} — run under "
+            "an 8-device virtual CPU topology (tools/dintlint.py does)")
+    from ..parallel.sharded import make_mesh
+    return make_mesh(n)
+
+
+# ------------------------------------------------------------ dense TATP
+
+
+def _tatp_dense(name: str, use_pallas: bool) -> TargetTrace:
+    from ..engines import tatp_dense as td
+    run = td.build_pipelined_runner(_N_SUB, w=_W, val_words=_VW,
+                                    cohorts_per_block=_BLK,
+                                    use_pallas=use_pallas)[0]
+    carry = _abstract(lambda: (td.create(_N_SUB, val_words=_VW,
+                                         log_capacity=_LOGCAP),
+                               td.empty_ctx(_W), td.empty_ctx(_W)))
+    return trace_target(name, run, (carry, _key_aval()))
+
+
+@register_target("tatp_dense/block",
+                 "flagship dense TATP fused 3-wave pipeline (XLA route)")
+def _t_tatp_dense() -> TargetTrace:
+    return _tatp_dense("tatp_dense/block", use_pallas=False)
+
+
+@register_target("tatp_dense/block@pallas",
+                 "dense TATP with the DMA-ring kernels (DINT_USE_PALLAS=1)")
+def _t_tatp_dense_pl() -> TargetTrace:
+    return _tatp_dense("tatp_dense/block@pallas", use_pallas=True)
+
+
+@register_target("tatp_dense/drain",
+                 "dense TATP pipeline drain (gen_new=False tail steps)")
+def _t_tatp_dense_drain() -> TargetTrace:
+    from ..engines import tatp_dense as td
+    drain = td.build_pipelined_runner(_N_SUB, w=_W, val_words=_VW,
+                                      cohorts_per_block=_BLK,
+                                      use_pallas=False)[2]
+    carry = _abstract(lambda: (td.create(_N_SUB, val_words=_VW,
+                                         log_capacity=_LOGCAP),
+                               td.empty_ctx(_W), td.empty_ctx(_W)))
+    return trace_target("tatp_dense/drain", drain, (carry,))
+
+
+# ------------------------------------------------------- dense SmallBank
+
+
+def _sb_dense(name: str, use_pallas: bool) -> TargetTrace:
+    from ..engines import smallbank_dense as sd
+    run = sd.build_pipelined_runner(_N_ACCT, w=_W, cohorts_per_block=_BLK,
+                                    use_pallas=use_pallas)[0]
+    carry = _abstract(lambda: (sd.create(_N_ACCT, log_capacity=_LOGCAP),
+                               sd.empty_ctx(_W)))
+    return trace_target(name, run, (carry, _key_aval()))
+
+
+@register_target("smallbank_dense/block",
+                 "dense SmallBank fused 2-wave pipeline (XLA route)")
+def _t_sb_dense() -> TargetTrace:
+    return _sb_dense("smallbank_dense/block", use_pallas=False)
+
+
+@register_target("smallbank_dense/block@pallas",
+                 "dense SmallBank with the DMA-ring gathers")
+def _t_sb_dense_pl() -> TargetTrace:
+    return _sb_dense("smallbank_dense/block@pallas", use_pallas=True)
+
+
+# ---------------------------------------------------- generic pipelines
+
+
+@register_target("tatp_pipeline/block",
+                 "generic (sort-based) fused TATP pipeline")
+def _t_tatp_pipeline() -> TargetTrace:
+    from ..engines import tatp
+    from ..engines import tatp_pipeline as tp
+    run, init, _ = tp.build_pipelined_runner(_N_SUB, w=_W, val_words=_VW,
+                                             cohorts_per_block=_BLK)
+    # same shapes as tatp_client.populate_shards (N_SHARDS identical
+    # replicas of tatp.create's geometry), no host-numpy population cost
+    carry = _abstract(lambda: init(tp.stack_shards(
+        [tatp.create(_N_SUB, val_words=_VW, cf_buckets=256,
+                     cf_lock_slots=256) for _ in range(tp.N_SHARDS)])))
+    return trace_target("tatp_pipeline/block", run, (carry, _key_aval()))
+
+
+@register_target("smallbank_pipeline/block",
+                 "generic (sort-based) fused SmallBank pipeline")
+def _t_sb_pipeline() -> TargetTrace:
+    from ..engines import smallbank_pipeline as sp
+    run = sp.build_runner(_N_ACCT, w=_W, cohorts_per_block=_BLK)
+    stacked = _abstract(lambda: sp.create_stacked(_N_ACCT))
+    return trace_target("smallbank_pipeline/block", run,
+                        (stacked, _key_aval()))
+
+
+# ------------------------------------------------------- generic sharded
+
+
+def _generic_sharded(name: str, engine: str) -> TargetTrace:
+    from ..engines.types import Op
+    from ..parallel import sharded
+    mesh = _mesh(_MESH_SHARDS)
+    if engine == "tatp":
+        from ..engines import tatp
+        state = _abstract(lambda: sharded.create_sharded_state(
+            mesh, _MESH_SHARDS, _N_SUB, val_words=_VW, cf_buckets=256,
+            cf_lock_slots=256))
+        tbl = tatp.SUBSCRIBER
+        vw = _VW
+    else:
+        from ..engines import smallbank
+        state = _abstract(lambda: sharded.create_sharded_smallbank(
+            mesh, _MESH_SHARDS, _N_ACCT, val_words=2))
+        tbl = smallbank.SAVINGS
+        vw = 2
+    step = sharded.build_sharded_step(mesh, _MESH_SHARDS, engine=engine)
+    m = 8
+    keys = np.arange(1, m + 1, dtype=np.int64)
+    ops = np.full(m, Op.OCC_LOCK, np.int32)
+    tbls = np.full(m, tbl, np.int32)
+    (batch,), _ = sharded.route_batches(ops, tbls, keys, None, None,
+                                        _MESH_SHARDS, m, vw)
+    batch = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(jnp.shape(x), jnp.asarray(x).dtype),
+        batch)
+    return trace_target(name, step, (state, batch),
+                        mesh_axes=(sharded.SHARD_AXIS,))
+
+
+@register_target("sharded/tatp",
+                 "generic replicated TATP shard step (3-role shard_map)")
+def _t_sharded_tatp() -> TargetTrace:
+    return _generic_sharded("sharded/tatp", "tatp")
+
+
+@register_target("sharded/smallbank",
+                 "generic replicated SmallBank shard step")
+def _t_sharded_sb() -> TargetTrace:
+    return _generic_sharded("sharded/smallbank", "smallbank")
+
+
+# --------------------------------------------------- dense multi-chip
+
+
+def _dense_sharded(name: str, use_pallas: bool) -> TargetTrace:
+    from ..parallel import dense_sharded as ds
+    mesh = _mesh(_MESH_SHARDS)
+    run, init, _ = ds.build_sharded_pipelined_runner(
+        mesh, _MESH_SHARDS, _N_SUB * _MESH_SHARDS, w=_W, val_words=_VW,
+        cohorts_per_block=_BLK, use_pallas=use_pallas)
+    carry = _abstract(lambda: init(ds.create_sharded(
+        mesh, _MESH_SHARDS, _N_SUB * _MESH_SHARDS, val_words=_VW,
+        log_capacity=_LOGCAP)))
+    return trace_target(name, run, (carry, _key_aval()),
+                        mesh_axes=(ds.SHARD_AXIS,))
+
+
+@register_target("dense_sharded/block",
+                 "multi-chip dense TATP: shard_map pipeline + CommitBck "
+                 "ppermute fan-out")
+def _t_dense_sharded() -> TargetTrace:
+    return _dense_sharded("dense_sharded/block", use_pallas=False)
+
+
+@register_target("dense_sharded/block@pallas",
+                 "multi-chip dense TATP with DMA-ring kernels inside the "
+                 "shard_map body")
+def _t_dense_sharded_pl() -> TargetTrace:
+    return _dense_sharded("dense_sharded/block@pallas", use_pallas=True)
+
+
+@register_target("dense_sharded_sb/block",
+                 "multi-chip dense SmallBank: owner-routed shard_map step")
+def _t_dense_sharded_sb() -> TargetTrace:
+    from ..parallel import dense_sharded_sb as dsb
+    mesh = _mesh(_MESH_SHARDS)
+    run, init, _ = dsb.build_sharded_sb_runner(
+        mesh, _MESH_SHARDS, _N_ACCT * _MESH_SHARDS, w=_W,
+        cohorts_per_block=_BLK, use_pallas=False)
+    carry = _abstract(lambda: init(dsb.create_sharded_sb(
+        mesh, _MESH_SHARDS, _N_ACCT * _MESH_SHARDS)))
+    return trace_target("dense_sharded_sb/block", run,
+                        (carry, _key_aval()),
+                        mesh_axes=(dsb.AXIS,))
+
+
+# ----------------------------------------------------------------- API
+
+_trace_cache: dict[str, TargetTrace] = {}
+
+
+def get_trace(name: str) -> TargetTrace:
+    """Build + trace a registered target (cached per process)."""
+    if name not in _trace_cache:
+        _trace_cache[name] = TARGETS[name]()
+    return _trace_cache[name]

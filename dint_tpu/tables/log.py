@@ -74,8 +74,12 @@ def append(ring: LogRing, do_append, table_id, is_del, key_hi, key_lo, ver, val)
     entry = jnp.concatenate(
         [flags[:, None], key_hi[:, None], key_lo[:, None], ver[:, None],
          val.astype(U32)], axis=1)
+    # one writer per (lane, slot): per-lane ranks are distinct and a batch
+    # appends << cap entries per lane, so slots cannot re-wrap in-batch;
+    # masked lanes route to the out-of-range row `lanes` and drop
     safe_lane = jnp.where(do_append, lane, lanes)
-    new_entries = ring.entries.at[safe_lane, slot].set(entry, mode="drop")
+    new_entries = ring.entries.at[safe_lane, slot].set(entry, mode="drop",
+                                                       unique_indices=True)
     new_head = ring.head + lane_counts
     return ring.replace(entries=new_entries, head=new_head), lane, slot
 

@@ -1,0 +1,333 @@
+"""dintlint: each pass proven live on a deliberately-broken mini step,
+silent on the matching safe idiom, suppressible by an allowlist entry —
+plus the standing tier-1 gate: the full pass suite over every registered
+engine/sharded target must report zero unsuppressed errors.
+
+The broken fixtures are the bug classes the passes exist for:
+  * a colliding scatter (no unique_indices, no segment mask),
+  * an aliased Pallas kernel whose donated input is read afterwards (and a
+    double-aliased one),
+  * a jitted call whose donated operand stays live,
+  * host callbacks / Python branching on traced data in a "step",
+  * a packed stamp cast to int32 and compared signed,
+  * ppermutes whose permutation disagrees with the mesh.
+"""
+import functools
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental import pallas as pl
+from jax.sharding import PartitionSpec as P
+
+import dint_tpu.parallel  # noqa: F401 — installs the jax.shard_map shim
+from dint_tpu import analysis
+from dint_tpu.analysis import allowlist as al
+from dint_tpu.analysis import core
+from dint_tpu.ops import segments
+
+S = jax.ShapeDtypeStruct
+U32 = jnp.uint32
+I32 = jnp.int32
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_pass(name, fn, args, mesh_axes=()):
+    tr = core.trace_target(f"fixture/{name}", fn, args, mesh_axes=mesh_axes)
+    return analysis.PASSES[name](tr)
+
+
+def codes(findings, severity=None):
+    return {f.code for f in findings
+            if severity is None or f.severity == severity}
+
+
+# ------------------------------------------------------------ scatter_race
+
+
+def test_scatter_race_fires_on_colliding_scatter():
+    def bad(tab, idx, v):
+        return tab.at[idx].set(v)       # arbitrary idx: duplicate = race
+
+    fs = run_pass("scatter_race", bad,
+                  (S((64,), U32), S((8,), I32), S((8,), U32)))
+    assert "nonunique-scatter" in codes(fs, "error")
+
+
+def test_scatter_race_accepts_declared_unique_and_segment_masked():
+    def ok_unique(tab, idx, v):
+        return tab.at[idx].set(v, mode="drop", unique_indices=True)
+
+    def ok_segmented(tab, kh, kl, v):
+        sb = segments.sort_batch(kh, kl)
+        return segments.scatter_rows(tab, sb.key_lo.astype(I32), v[sb.perm],
+                                     sb.last)   # one writer per key
+
+    fs1 = run_pass("scatter_race", ok_unique,
+                   (S((64,), U32), S((8,), I32), S((8,), U32)))
+    fs2 = run_pass("scatter_race", ok_segmented,
+                   (S((64,), U32), S((8,), U32), S((8,), U32), S((8,), U32)))
+    assert not codes(fs1, "error") and not codes(fs2, "error")
+
+
+# ---------------------------------------------------------------- aliasing
+
+
+def _inc_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] + 1
+
+
+def test_aliasing_pallas_use_after_donate():
+    def bad(x):
+        y = pl.pallas_call(_inc_kernel, out_shape=S(x.shape, x.dtype),
+                           input_output_aliases={0: 0}, interpret=True)(x)
+        return y + x        # x was updated in place: torn read
+
+    def ok(x):
+        y = pl.pallas_call(_inc_kernel, out_shape=S(x.shape, x.dtype),
+                           input_output_aliases={0: 0}, interpret=True)(x)
+        return y + 1        # only the kernel's output is used
+
+    assert "use-after-donate" in codes(run_pass("aliasing", bad,
+                                                (S((8,), U32),)), "error")
+    assert not codes(run_pass("aliasing", ok, (S((8,), U32),)), "error")
+
+
+def test_aliasing_double_aliased_kernel():
+    def _add_kernel(x_ref, y_ref, o_ref):
+        o_ref[...] = x_ref[...] + y_ref[...]
+
+    def bad(x, y):
+        return pl.pallas_call(_add_kernel, out_shape=S(x.shape, x.dtype),
+                              input_output_aliases={0: 0, 1: 0},
+                              interpret=True)(x, y)
+
+    fs = run_pass("aliasing", bad, (S((8,), U32), S((8,), U32)))
+    assert "double-alias-output" in codes(fs, "error")
+
+
+def test_aliasing_pjit_donated_operand_still_live():
+    @functools.partial(jax.jit, donate_argnums=0)
+    def g(x):
+        return x + 1
+
+    def bad(x):
+        y = g(x)
+        return y + x
+
+    fs = run_pass("aliasing", bad, (S((8,), jnp.float32),))
+    assert "use-after-donate" in codes(fs, "error")
+
+
+# ------------------------------------------------------------------ purity
+
+
+def test_purity_flags_callbacks_and_debug_print():
+    def bad_cb(x):
+        return jax.pure_callback(lambda a: np.asarray(a),
+                                 S((), jnp.float32), x.sum())
+
+    def warn_dbg(x):
+        jax.debug.print("x={x}", x=x.sum())
+        return x * 2
+
+    assert "pure_callback" in codes(run_pass("purity", bad_cb,
+                                             (S((8,), jnp.float32),)),
+                                    "error")
+    fs = run_pass("purity", warn_dbg, (S((8,), jnp.float32),))
+    assert "debug_callback" in codes(fs, "warning") and not codes(fs, "error")
+
+
+def test_purity_flags_python_branch_on_traced_data():
+    def bad(x):
+        if x.sum() > 0:     # concretizes a tracer: host sync + retrace
+            return x
+        return -x
+
+    fs = run_pass("purity", bad, (S((8,), jnp.float32),))
+    assert "untraceable" in codes(fs, "error")
+
+
+# ------------------------------------------------------------ u64_overflow
+
+
+def test_u64_flags_stamp_sign_drift_and_signed_compare():
+    def bad(step, lane):
+        packed = ((step << U32(18)) | lane).astype(I32)
+        return packed < 0
+
+    fs = run_pass("u64_overflow", bad, (S((8,), U32), S((8,), U32)))
+    assert {"stamp-sign-drift", "signed-stamp-compare"} <= codes(fs, "error")
+
+
+def test_u64_accepts_masked_convert():
+    def ok(step, lane):
+        # masked below 2^31 before the convert: the repo's bucket-index idiom
+        packed = (((step << U32(18)) | lane) & U32(0x3FFFF)).astype(I32)
+        return packed < 0
+
+    assert not run_pass("u64_overflow", ok, (S((8,), U32), S((8,), U32)))
+
+
+# ------------------------------------------------------ shard_consistency
+
+
+def _mesh4():
+    from dint_tpu.parallel.sharded import make_mesh
+    assert len(jax.devices()) >= 4
+    return make_mesh(4)
+
+
+def test_shard_consistency_flags_bad_perms():
+    mesh = _mesh4()
+
+    def dup_dest(x):
+        return jax.lax.ppermute(x, "shard", [(0, 1), (2, 1)])
+
+    def out_of_range(x):
+        return jax.lax.ppermute(x, "shard", [(0, 7)])
+
+    def ok(x):
+        return jax.lax.ppermute(x, "shard",
+                                [(i, (i + 1) % 4) for i in range(4)])
+
+    def sm(body):
+        return jax.shard_map(body, mesh=mesh, in_specs=P("shard"),
+                             out_specs=P("shard"))
+
+    arg = (S((8, 4), jnp.float32),)
+    assert "perm-duplicate-dest" in codes(
+        run_pass("shard_consistency", sm(dup_dest), arg), "error")
+    assert "perm-out-of-range" in codes(
+        run_pass("shard_consistency", sm(out_of_range), arg), "error")
+    assert not codes(run_pass("shard_consistency", sm(ok), arg), "error")
+
+
+# --------------------------------------------------------------- allowlist
+
+
+def _broken_scatter_findings():
+    def bad(tab, idx, v):
+        return tab.at[idx].set(v)
+
+    return run_pass("scatter_race", bad,
+                    (S((64,), U32), S((8,), I32), S((8,), U32)))
+
+
+def test_allowlist_suppresses_matched_finding(tmp_path):
+    path = tmp_path / "allow.json"
+    path.write_text(json.dumps([
+        {"pass": "scatter_race", "code": "nonunique-scatter",
+         "target": "fixture/scatter_race",
+         "reason": "fixture: uniqueness proven by the test harness"}]))
+    fs = al.apply(_broken_scatter_findings(), al.load(str(path)))
+    assert not analysis.has_errors(fs)
+    assert any(f.suppressed for f in fs)     # visible, flagged, not hidden
+
+
+def test_allowlist_requires_reason_and_reports_stale_entries(tmp_path):
+    bad = tmp_path / "noreason.json"
+    bad.write_text(json.dumps([{"pass": "x", "code": "y"}]))
+    with pytest.raises(al.AllowlistError):
+        al.load(str(bad))
+
+    stale = tmp_path / "stale.json"
+    stale.write_text(json.dumps([
+        {"pass": "scatter_race", "code": "no-such-code",
+         "reason": "matches nothing"}]))
+    fs = al.apply(_broken_scatter_findings(), al.load(str(stale)))
+    assert "unused-entry" in codes(fs, "warning")
+    assert analysis.has_errors(fs)           # the real finding stays fatal
+
+
+def test_allowlist_mismatch_does_not_suppress(tmp_path):
+    path = tmp_path / "other.json"
+    path.write_text(json.dumps([
+        {"pass": "scatter_race", "code": "nonunique-scatter",
+         "target": "some/other-target", "reason": "scoped elsewhere"}]))
+    fs = al.apply(_broken_scatter_findings(), al.load(str(path)),
+                  check_unused=False)
+    assert analysis.has_errors(fs)
+
+
+def _broken_findings(pname):
+    """Fresh findings from the canonical broken fixture of each pass."""
+    if pname == "scatter_race":
+        return _broken_scatter_findings()
+    if pname == "aliasing":
+        def bad(x):
+            y = pl.pallas_call(_inc_kernel, out_shape=S(x.shape, x.dtype),
+                               input_output_aliases={0: 0},
+                               interpret=True)(x)
+            return y + x
+        return run_pass("aliasing", bad, (S((8,), U32),))
+    if pname == "purity":
+        def bad(x):
+            return jax.pure_callback(lambda a: np.asarray(a),
+                                     S((), jnp.float32), x.sum())
+        return run_pass("purity", bad, (S((8,), jnp.float32),))
+    if pname == "u64_overflow":
+        def bad(step, lane):
+            return ((step << U32(18)) | lane).astype(I32) < 0
+        return run_pass("u64_overflow", bad, (S((8,), U32), S((8,), U32)))
+    if pname == "shard_consistency":
+        def body(x):
+            return jax.lax.ppermute(x, "shard", [(0, 1), (2, 1)])
+        sm = jax.shard_map(body, mesh=_mesh4(), in_specs=P("shard"),
+                           out_specs=P("shard"))
+        return run_pass("shard_consistency", sm, (S((8, 4), jnp.float32),))
+    raise AssertionError(pname)
+
+
+@pytest.mark.parametrize("pname", sorted(analysis.PASSES))
+def test_every_pass_fires_and_is_allowlist_silenceable(pname, tmp_path):
+    """Acceptance contract: each registered pass is proven live by a
+    deliberately-broken fixture that FAILS the lint, and a scoped
+    allowlist entry silences exactly that failure."""
+    findings = _broken_findings(pname)
+    assert analysis.has_errors(findings), f"{pname} fixture did not fire"
+
+    path = tmp_path / "allow.json"
+    path.write_text(json.dumps([
+        {"pass": pname, "code": "*", "target": f"fixture/{pname}",
+         "reason": "test fixture: violation is constructed on purpose"}]))
+    fs = al.apply(_broken_findings(pname), al.load(str(path)),
+                  check_unused=False)
+    assert not analysis.has_errors(fs)
+    assert any(f.suppressed for f in fs)
+
+
+# ------------------------------------------------------------ tier-1 gate
+
+
+@pytest.mark.lint
+def test_dintlint_gate_all_targets():
+    """The standing CI gate: every registered engine/sharded target, every
+    pass, repo allowlist applied — zero unsuppressed errors."""
+    allow = os.path.join(REPO, "tools", "dintlint_allow.json")
+    findings = analysis.run(
+        allowlist_path=allow if os.path.exists(allow) else None)
+    errors = [str(f) for f in findings
+              if f.severity == "error" and not f.suppressed]
+    assert not errors, "dintlint gate failed:\n" + "\n".join(errors)
+
+
+@pytest.mark.lint
+def test_cli_json_single_target():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "dintlint.py"),
+         "--target", "tatp_dense/block", "--json"],
+        capture_output=True, text=True, cwd=REPO, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    payload = json.loads(out.stdout.strip().splitlines()[-1])
+    assert payload["metric"] == "dintlint" and payload["ok"] is True
+    # schema-stable keys downstream parsing relies on
+    for k in ("targets", "passes", "n_findings", "n_errors",
+              "n_suppressed", "findings"):
+        assert k in payload

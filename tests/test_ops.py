@@ -48,6 +48,57 @@ def test_u64_add_lt(rng):
     assert np.array_equal(np.asarray(u64.lt(a_hi, a_lo, b_hi, b_lo)), a < b)
 
 
+def test_u64_carry_boundaries():
+    """Stamp arithmetic at the hi-word carry boundary: ground truth for the
+    dintlint u64_overflow pass (ANALYSIS.md). 0xFFFFFFFF -> 0x1_00000000 is
+    exactly where a lo-word-only (or sign-drifted int32) implementation
+    silently wraps while the (hi, lo) pair must carry."""
+    edges = np.array([0xFFFFFFFF,              # lo all-ones: +1 must carry
+                      0x1_00000000,            # the carry landing point
+                      0x1_FFFFFFFF,
+                      0x7FFFFFFF,              # int32 sign boundary
+                      0x80000000,              # int32 wraparound point
+                      0xFFFFFFFF_FFFFFFFF],    # max stamp
+                     dtype=np.uint64)
+    one = (jnp.zeros(len(edges), jnp.uint32), jnp.ones(len(edges), jnp.uint32))
+    hi, lo = map(jnp.asarray, u64.split(edges))
+    s_hi, s_lo = jax.jit(u64.add)(hi, lo, *one)
+    with np.errstate(over="ignore"):
+        want = edges + np.uint64(1)            # max-stamp wraps to 0
+    assert np.array_equal(u64.join(np.asarray(s_hi), np.asarray(s_lo)), want)
+    # the max stamp + 1 wrapped all the way to zero through BOTH words
+    assert int(np.asarray(s_hi)[-1]) == 0 and int(np.asarray(s_lo)[-1]) == 0
+
+
+def test_u64_lt_at_hi_word_boundary():
+    """Unsigned compare must order by the hi word first: 0xFFFFFFFF (hi=0)
+    < 0x1_00000000 (hi=1) even though the lo words compare the other way —
+    the compare a signed/lo-only stamp implementation gets wrong."""
+    a = np.array([0xFFFFFFFF, 0x1_00000000, 0x80000000,
+                  0xFFFFFFFF_FFFFFFFF, 0x7FFFFFFF_FFFFFFFF],
+                 dtype=np.uint64)
+    b = np.array([0x1_00000000, 0xFFFFFFFF, 0x7FFFFFFF,
+                  0x0, 0x80000000_00000000], dtype=np.uint64)
+    a_hi, a_lo = map(jnp.asarray, u64.split(a))
+    b_hi, b_lo = map(jnp.asarray, u64.split(b))
+    assert np.array_equal(np.asarray(jax.jit(u64.lt)(a_hi, a_lo,
+                                                     b_hi, b_lo)), a < b)
+    assert np.array_equal(np.asarray(jax.jit(u64.eq)(a_hi, a_lo,
+                                                     b_hi, b_lo)), a == b)
+
+
+def test_u64_mul32x32_carry_saturation():
+    """mul32x32's 16-bit-limb mid-sum carries (c1+c2) at the all-ones
+    inputs: 0xFFFFFFFF^2 = 0xFFFFFFFE_00000001 exercises both carry
+    outs; a dropped carry loses bit 32/33 of the product."""
+    vals = np.array([0xFFFFFFFF, 0xFFFF0001, 0x80000000, 0x10001],
+                    np.uint64).astype(np.uint32)
+    a = jnp.asarray(vals)
+    hi, lo = jax.jit(u64.mul32x32)(a, a)
+    want = vals.astype(np.uint64) * vals.astype(np.uint64)
+    assert np.array_equal(u64.join(np.asarray(hi), np.asarray(lo)), want)
+
+
 def test_hash_device_matches_host(rng):
     keys = rng.integers(0, 1 << 64, size=2048, dtype=np.uint64)
     hi, lo = map(jnp.asarray, u64.split(keys))

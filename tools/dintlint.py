@@ -1,0 +1,119 @@
+"""dintlint CLI: static analysis gate over every registered hot path.
+
+Runs the dint_tpu/analysis pass suite (scatter races, buffer aliasing,
+hot-path purity, u64 stamp overflow, shard_map consistency — ANALYSIS.md)
+over the registered engine/sharded step functions, traced with abstract
+values on CPU: no TPU, no tunnel window, CI-speed.
+
+Usage:
+    python tools/dintlint.py --all                    # everything
+    python tools/dintlint.py --target tatp_dense/block --target sharded/tatp
+    python tools/dintlint.py --all --pass scatter_race --pass aliasing
+    python tools/dintlint.py --all --json             # one JSON line
+    python tools/dintlint.py --all --allowlist tools/dintlint_allow.json
+    python tools/dintlint.py --list                   # targets + passes
+
+Exit code: 0 when no unsuppressed error-severity finding remains (warnings
+and info never fail the gate), 1 otherwise, 2 on usage errors. The default
+allowlist is tools/dintlint_allow.json when it exists; every suppression
+needs a written reason and stays visible in the report (analysis/allowlist).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# the mesh targets need the same 8-device virtual CPU topology as
+# tests/conftest.py — and it must be pinned BEFORE jax initializes backends
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+from dint_tpu import analysis  # noqa: E402
+
+DEFAULT_ALLOWLIST = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "dintlint_allow.json")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dintlint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--all", action="store_true",
+                    help="lint every registered target")
+    ap.add_argument("--target", action="append", default=[],
+                    help="target name (repeatable); see --list")
+    ap.add_argument("--pass", dest="passes", action="append", default=[],
+                    help="pass name (repeatable); default: all passes")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one machine-parseable JSON line")
+    ap.add_argument("--allowlist", default=None,
+                    help="allowlist JSON path (default: "
+                         "tools/dintlint_allow.json when present)")
+    ap.add_argument("--list", action="store_true",
+                    help="list registered targets and passes, then exit")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        print("targets:")
+        for name, doc in analysis.TARGET_DOCS.items():
+            print(f"  {name:32s} {doc}")
+        print("passes:")
+        for name, doc in analysis.PASS_DOCS.items():
+            print(f"  {name:32s} {doc}")
+        return 0
+
+    if not args.all and not args.target:
+        ap.error("pick targets with --target/--all (or --list to see them)")
+
+    allowlist = args.allowlist
+    if allowlist is None and os.path.exists(DEFAULT_ALLOWLIST):
+        allowlist = DEFAULT_ALLOWLIST
+
+    try:
+        findings = analysis.run(
+            targets=None if args.all else args.target,
+            passes=args.passes or None,
+            allowlist_path=allowlist)
+    except KeyError as e:
+        ap.error(str(e))
+
+    failed = analysis.has_errors(findings)
+    if args.json:
+        print(json.dumps({
+            "metric": "dintlint",
+            "targets": (sorted(analysis.TARGETS) if args.all
+                        else args.target),
+            "passes": args.passes or sorted(analysis.PASSES),
+            "allowlist": allowlist,
+            "n_findings": len(findings),
+            "n_errors": sum(f.severity == "error" and not f.suppressed
+                            for f in findings),
+            "n_suppressed": sum(f.suppressed for f in findings),
+            "ok": not failed,
+            "findings": [f.to_dict() for f in findings],
+        }), flush=True)
+    else:
+        for f in findings:
+            print(f)
+        n_err = sum(f.severity == "error" and not f.suppressed
+                    for f in findings)
+        n_sup = sum(f.suppressed for f in findings)
+        print(f"dintlint: {len(findings)} finding(s), {n_err} error(s), "
+              f"{n_sup} suppressed -> {'FAIL' if failed else 'ok'}",
+              flush=True)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
