@@ -119,6 +119,14 @@ def _child_main():
     # gather (one [w,K] single-word random gather over the 6.2 GB val
     # array) to measure its cost; the default keeps the integrity oracle
     check_magic = os.environ.get("DINT_BENCH_CHECK_MAGIC", "1") != "0"
+    # DINT_MONITOR=1 threads the dintmon counter plane through the carry
+    # (dint_tpu/monitor, OBSERVABILITY.md): the artifact embeds the
+    # end-of-run counter snapshot, and DINT_MONITOR_JSONL=path
+    # additionally emits one wave event per dispatched block (the
+    # per-block counter fetch is ~100 bytes but synchronizes the stream,
+    # so leave it off for headline numbers). Off (default) the engines
+    # run the unmonitored jaxpr and the artifact records counters: null.
+    monitor_on = os.environ.get("DINT_MONITOR") == "1"
     # DINT_USE_PALLAS=1 routes the step's random-access hot ops through the
     # DMA-ring kernels (ops/pallas_gather); the builder's probe degrades to
     # the XLA path on Mosaic rejection, and the retry below additionally
@@ -139,7 +147,7 @@ def _child_main():
         run, init, drain = td.build_pipelined_runner(
             N_SUBSCRIBERS, w=WIDTH, val_words=VAL_WORDS,
             cohorts_per_block=BLOCK, check_magic=check_magic,
-            use_pallas=use_pallas)
+            use_pallas=use_pallas, monitor=monitor_on)
         carry = init(db)
         populate_s = _time.time() - t0
 
@@ -168,6 +176,30 @@ def _child_main():
         (run, drain, carry, stats0,
          populate_s, compile_s) = build_and_warm(False)
 
+    # dintmon drain loop: per-block wave events when a JSONL path is set
+    # (the per-block counter fetch synchronizes the stream — an accepted
+    # cost of asking for the timeline), end-of-run snapshot either way
+    monitor_obj = None
+    if monitor_on:
+        from dint_tpu import monitor as dm
+
+        jsonl = os.environ.get("DINT_MONITOR_JSONL")
+        writer = dm.TraceWriter(jsonl, meta={
+            "name": "bench_tatp", "width": WIDTH, "block": BLOCK,
+            "n_subscribers": N_SUBSCRIBERS,
+            "use_pallas": bool(use_pallas)}) if jsonl else None
+        monitor_obj = dm.Monitor(writer)
+        if writer is not None:
+            bare_run, t_prev = run, [_time.time()]
+
+            def run(carry, key, _run=bare_run):
+                carry, stats = _run(carry, key)
+                now = _time.time()
+                monitor_obj.observe(carry[-1], batch=WIDTH * BLOCK,
+                                    dur_s=now - t_prev[0])
+                t_prev[0] = now
+                return carry, stats
+
     # host core-seconds strictly over the timed window (warmup above);
     # no device_duty field: the axon platform exposes no honest
     # device-busy counter (block_until_ready returns early), and the
@@ -182,20 +214,31 @@ def _child_main():
         if os.environ.get("DINT_BENCH_PROFILE") == "1" else None
     trace_err = None
     if trace_dir:   # must precede drain: drain donates the carry
+        from dint_tpu.monitor import trace as mtrace
         try:
-            with jax.profiler.trace(trace_dir):
+            with mtrace.profiler_session(trace_dir) as prof:
                 carry, s = run(carry, jax.random.PRNGKey(1234))
                 np.asarray(s)
+            trace_err = prof.get("error")
         except Exception as e:
             # run() donated the old carry; a mid-run failure leaves no
             # usable carry to drain — keep the windowed measurement
             trace_err = repr(e)[:200]
             carry = None
 
+    counters_out = None
     if carry is not None:
-        _, tail = drain(carry)
+        if monitor_on:
+            _, tail, cnt_final = drain(carry)
+            from dint_tpu import monitor as dm
+            counters_out = dm.snapshot(cnt_final)
+        else:
+            _, tail = drain(carry)
         # in-flight cohorts at window end emit their stats on completion
         total = total + np.asarray(tail, np.int64).sum(axis=0)
+    elif monitor_obj is not None:
+        # carry voided mid-trace: the last per-block snapshot still stands
+        counters_out = monitor_obj.prev
 
     committed = int(total[td.STAT_COMMITTED])
     attempted = int(total[td.STAT_ATTEMPTED])
@@ -242,6 +285,10 @@ def _child_main():
         # which random-access backend actually ran (pallas may have been
         # requested and degraded) — A/B artifacts must be distinguishable
         "use_pallas": bool(use_pallas),
+        # end-of-run dintmon snapshot, schema-stable: a {name: count}
+        # object when DINT_MONITOR=1, EXPLICIT null otherwise — consumers
+        # never need to distinguish "off" from "old artifact schema"
+        "counters": counters_out,
         **({} if check_magic else {"integrity_checks": "off (A/B knob)"}),
         "blocks": blocks,
         "window_s": round(dt, 2),
@@ -328,6 +375,8 @@ def _persist_artifact(out: dict):
                             f"BENCH_{out['commit']}_{out['ts']}.json")
         with open(path, "w") as f:
             json.dump(out, f, indent=1)
+        # stderr, not stdout: the driver parses stdout's last JSON line
+        print(f"artifact written: {path}", file=sys.stderr)
     except OSError as e:
         print(f"artifact write failed: {e!r}", file=sys.stderr)
 

@@ -14,7 +14,11 @@ routes), the dense pipeline drain, both generic fused pipelines, the
 generic replicated shard step, and both dense multi-chip runners. The
 Pallas variants force ``use_pallas=True`` so the aliasing pass sees real
 ``pallas_call`` input_output_aliases; on CPU the kernels trace in
-interpret mode (ops/pallas_gather.use_interpret).
+interpret mode (ops/pallas_gather.use_interpret). The ``@mon`` variants
+re-register every dintmon-instrumented step with the counter plane
+threaded (OBSERVABILITY.md): the counter scatter-adds must themselves
+pass scatter_race, and the monitored pallas route proves the pre-kernel
+held-stamp read clears the aliasing pass.
 
 Mesh targets need >= `_MESH_SHARDS` devices; the dintlint CLI forces an
 8-device virtual CPU topology exactly like tests/conftest.py, and targets
@@ -82,14 +86,18 @@ def _mesh(n: int):
 # ------------------------------------------------------------ dense TATP
 
 
-def _tatp_dense(name: str, use_pallas: bool) -> TargetTrace:
+def _tatp_dense(name: str, use_pallas: bool,
+                monitor: bool = False) -> TargetTrace:
     from ..engines import tatp_dense as td
-    run = td.build_pipelined_runner(_N_SUB, w=_W, val_words=_VW,
-                                    cohorts_per_block=_BLK,
-                                    use_pallas=use_pallas)[0]
+    from .. import monitor as mn
+    run, init, _ = td.build_pipelined_runner(_N_SUB, w=_W, val_words=_VW,
+                                             cohorts_per_block=_BLK,
+                                             use_pallas=use_pallas,
+                                             monitor=monitor)
     carry = _abstract(lambda: (td.create(_N_SUB, val_words=_VW,
                                          log_capacity=_LOGCAP),
-                               td.empty_ctx(_W), td.empty_ctx(_W)))
+                               td.empty_ctx(_W), td.empty_ctx(_W))
+                      + ((mn.create(),) if monitor else ()))
     return trace_target(name, run, (carry, _key_aval()))
 
 
@@ -103,6 +111,21 @@ def _t_tatp_dense() -> TargetTrace:
                  "dense TATP with the DMA-ring kernels (DINT_USE_PALLAS=1)")
 def _t_tatp_dense_pl() -> TargetTrace:
     return _tatp_dense("tatp_dense/block@pallas", use_pallas=True)
+
+
+@register_target("tatp_dense/block@mon",
+                 "dense TATP with the dintmon counter plane threaded")
+def _t_tatp_dense_mon() -> TargetTrace:
+    return _tatp_dense("tatp_dense/block@mon", use_pallas=False,
+                       monitor=True)
+
+
+@register_target("tatp_dense/block@mon+pallas",
+                 "dense TATP: counter plane + DMA-ring kernels (proves the "
+                 "pre-kernel held-stamp read passes the aliasing pass)")
+def _t_tatp_dense_mon_pl() -> TargetTrace:
+    return _tatp_dense("tatp_dense/block@mon+pallas", use_pallas=True,
+                       monitor=True)
 
 
 @register_target("tatp_dense/drain",
@@ -121,12 +144,16 @@ def _t_tatp_dense_drain() -> TargetTrace:
 # ------------------------------------------------------- dense SmallBank
 
 
-def _sb_dense(name: str, use_pallas: bool) -> TargetTrace:
+def _sb_dense(name: str, use_pallas: bool,
+              monitor: bool = False) -> TargetTrace:
     from ..engines import smallbank_dense as sd
+    from .. import monitor as mn
     run = sd.build_pipelined_runner(_N_ACCT, w=_W, cohorts_per_block=_BLK,
-                                    use_pallas=use_pallas)[0]
+                                    use_pallas=use_pallas,
+                                    monitor=monitor)[0]
     carry = _abstract(lambda: (sd.create(_N_ACCT, log_capacity=_LOGCAP),
-                               sd.empty_ctx(_W)))
+                               sd.empty_ctx(_W))
+                      + ((mn.create(),) if monitor else ()))
     return trace_target(name, run, (carry, _key_aval()))
 
 
@@ -142,32 +169,62 @@ def _t_sb_dense_pl() -> TargetTrace:
     return _sb_dense("smallbank_dense/block@pallas", use_pallas=True)
 
 
+@register_target("smallbank_dense/block@mon",
+                 "dense SmallBank with the dintmon counter plane threaded")
+def _t_sb_dense_mon() -> TargetTrace:
+    return _sb_dense("smallbank_dense/block@mon", use_pallas=False,
+                     monitor=True)
+
+
 # ---------------------------------------------------- generic pipelines
 
 
-@register_target("tatp_pipeline/block",
-                 "generic (sort-based) fused TATP pipeline")
-def _t_tatp_pipeline() -> TargetTrace:
+def _tatp_pipeline(name: str, monitor: bool = False) -> TargetTrace:
     from ..engines import tatp
     from ..engines import tatp_pipeline as tp
     run, init, _ = tp.build_pipelined_runner(_N_SUB, w=_W, val_words=_VW,
-                                             cohorts_per_block=_BLK)
+                                             cohorts_per_block=_BLK,
+                                             monitor=monitor)
     # same shapes as tatp_client.populate_shards (N_SHARDS identical
     # replicas of tatp.create's geometry), no host-numpy population cost
     carry = _abstract(lambda: init(tp.stack_shards(
         [tatp.create(_N_SUB, val_words=_VW, cf_buckets=256,
                      cf_lock_slots=256) for _ in range(tp.N_SHARDS)])))
-    return trace_target("tatp_pipeline/block", run, (carry, _key_aval()))
+    return trace_target(name, run, (carry, _key_aval()))
+
+
+@register_target("tatp_pipeline/block",
+                 "generic (sort-based) fused TATP pipeline")
+def _t_tatp_pipeline() -> TargetTrace:
+    return _tatp_pipeline("tatp_pipeline/block")
+
+
+@register_target("tatp_pipeline/block@mon",
+                 "generic TATP pipeline with the counter plane threaded")
+def _t_tatp_pipeline_mon() -> TargetTrace:
+    return _tatp_pipeline("tatp_pipeline/block@mon", monitor=True)
+
+
+def _sb_pipeline(name: str, monitor: bool = False) -> TargetTrace:
+    from ..engines import smallbank_pipeline as sp
+    from .. import monitor as mn
+    run = sp.build_runner(_N_ACCT, w=_W, cohorts_per_block=_BLK,
+                          monitor=monitor)
+    stacked = _abstract(lambda: sp.create_stacked(_N_ACCT))
+    carry = (stacked, _abstract(mn.create)) if monitor else stacked
+    return trace_target(name, run, (carry, _key_aval()))
 
 
 @register_target("smallbank_pipeline/block",
                  "generic (sort-based) fused SmallBank pipeline")
 def _t_sb_pipeline() -> TargetTrace:
-    from ..engines import smallbank_pipeline as sp
-    run = sp.build_runner(_N_ACCT, w=_W, cohorts_per_block=_BLK)
-    stacked = _abstract(lambda: sp.create_stacked(_N_ACCT))
-    return trace_target("smallbank_pipeline/block", run,
-                        (stacked, _key_aval()))
+    return _sb_pipeline("smallbank_pipeline/block")
+
+
+@register_target("smallbank_pipeline/block@mon",
+                 "generic SmallBank pipeline with the counter plane")
+def _t_sb_pipeline_mon() -> TargetTrace:
+    return _sb_pipeline("smallbank_pipeline/block@mon", monitor=True)
 
 
 # ------------------------------------------------------- generic sharded
@@ -219,12 +276,13 @@ def _t_sharded_sb() -> TargetTrace:
 # --------------------------------------------------- dense multi-chip
 
 
-def _dense_sharded(name: str, use_pallas: bool) -> TargetTrace:
+def _dense_sharded(name: str, use_pallas: bool,
+                   monitor: bool = False) -> TargetTrace:
     from ..parallel import dense_sharded as ds
     mesh = _mesh(_MESH_SHARDS)
     run, init, _ = ds.build_sharded_pipelined_runner(
         mesh, _MESH_SHARDS, _N_SUB * _MESH_SHARDS, w=_W, val_words=_VW,
-        cohorts_per_block=_BLK, use_pallas=use_pallas)
+        cohorts_per_block=_BLK, use_pallas=use_pallas, monitor=monitor)
     carry = _abstract(lambda: init(ds.create_sharded(
         mesh, _MESH_SHARDS, _N_SUB * _MESH_SHARDS, val_words=_VW,
         log_capacity=_LOGCAP)))
@@ -246,19 +304,36 @@ def _t_dense_sharded_pl() -> TargetTrace:
     return _dense_sharded("dense_sharded/block@pallas", use_pallas=True)
 
 
-@register_target("dense_sharded_sb/block",
-                 "multi-chip dense SmallBank: owner-routed shard_map step")
-def _t_dense_sharded_sb() -> TargetTrace:
+@register_target("dense_sharded/block@mon",
+                 "multi-chip dense TATP with per-device counter planes")
+def _t_dense_sharded_mon() -> TargetTrace:
+    return _dense_sharded("dense_sharded/block@mon", use_pallas=False,
+                          monitor=True)
+
+
+def _dense_sharded_sb(name: str, monitor: bool = False) -> TargetTrace:
     from ..parallel import dense_sharded_sb as dsb
     mesh = _mesh(_MESH_SHARDS)
     run, init, _ = dsb.build_sharded_sb_runner(
         mesh, _MESH_SHARDS, _N_ACCT * _MESH_SHARDS, w=_W,
-        cohorts_per_block=_BLK, use_pallas=False)
+        cohorts_per_block=_BLK, use_pallas=False, monitor=monitor)
     carry = _abstract(lambda: init(dsb.create_sharded_sb(
         mesh, _MESH_SHARDS, _N_ACCT * _MESH_SHARDS)))
-    return trace_target("dense_sharded_sb/block", run,
-                        (carry, _key_aval()),
+    return trace_target(name, run, (carry, _key_aval()),
                         mesh_axes=(dsb.AXIS,))
+
+
+@register_target("dense_sharded_sb/block",
+                 "multi-chip dense SmallBank: owner-routed shard_map step")
+def _t_dense_sharded_sb() -> TargetTrace:
+    return _dense_sharded_sb("dense_sharded_sb/block")
+
+
+@register_target("dense_sharded_sb/block@mon",
+                 "multi-chip dense SmallBank with per-device counter "
+                 "planes")
+def _t_dense_sharded_sb_mon() -> TargetTrace:
+    return _dense_sharded_sb("dense_sharded_sb/block@mon", monitor=True)
 
 
 # ----------------------------------------------------------------- API

@@ -76,6 +76,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..monitor import counters as mon
 from ..ops import pallas_gather as pg
 from ..tables import log as logring
 from .types import Op
@@ -202,7 +203,8 @@ def _stats_of(c: BankCtx):
 
 def pipe_step(db: DenseBank, c1: BankCtx, key, *, w: int, n_accounts: int,
               gen_new: bool = True, hot_frac=None, hot_prob=None, mix=None,
-              use_pallas: bool = False):
+              use_pallas: bool = False,
+              counters: mon.Counters | None = None):
     """One fused device step: wave 1 of a NEW cohort acquires against c1's
     STILL-HELD stamps (stamp == step-1), then wave 2 installs c1's writes.
     Returns (db', new_ctx, stats-of-c1).
@@ -212,7 +214,14 @@ def pipe_step(db: DenseBank, c1: BankCtx, key, *, w: int, n_accounts: int,
     through the DMA-ring kernel (ops/pallas_gather.gather_rows),
     bit-identical to the XLA gathers; the scatter-min arbitration and the
     install scatters stay XLA (they are already 1-D unique-index fast
-    paths)."""
+    paths).
+
+    ``counters`` (monitor.Counters | None): the dintmon counter plane —
+    txn outcomes from c1's completing stats, S/X arbitration won-vs-lost
+    (held-slot rejects split from intra-batch losses), install/log
+    counts, ring high-water, backend dispatch. When threaded the updated
+    Counters is appended to the return tuple; None (default) leaves the
+    jaxpr untouched."""
     m1 = 2 * n_accounts + 1
     sent = m1 - 1
     oob = m1
@@ -312,12 +321,38 @@ def pipe_step(db: DenseBank, c1: BankCtx, key, *, w: int, n_accounts: int,
 
     db = db.replace(bal=bal_new, x_step=x_step, s_step=s_step,
                     step=t + 1, log=logs)
+    if counters is not None:
+        act_l = active.reshape(-1)
+        grant_l = granted.reshape(-1)
+        held_l = held_x | held_s            # [wL] slot stamped last step
+        rej_l = act_l & ~grant_l
+        counters = mon.bump(counters, {
+            mon.CTR_STEPS: 1,
+            mon.CTR_TXN_ATTEMPTED: c1.attempted,
+            mon.CTR_TXN_COMMITTED: c1.committed,
+            mon.CTR_AB_LOCK: c1.ab_lock,
+            mon.CTR_AB_LOGIC: c1.ab_logic,
+            mon.CTR_MAGIC_BAD: c1.magic_bad,
+            mon.CTR_LOCK_REQUESTS: act_l.sum(dtype=I32),
+            mon.CTR_LOCK_GRANTED: grant_l.sum(dtype=I32),
+            mon.CTR_LOCK_REJECTED: rej_l.sum(dtype=I32),
+            mon.CTR_LOCK_REJECT_HELD: (rej_l & held_l).sum(dtype=I32),
+            mon.CTR_LOCK_REJECT_ARB: (rej_l & ~held_l).sum(dtype=I32),
+            mon.CTR_INSTALL_WRITES: dwf.sum(dtype=I32),
+            mon.CTR_LOG_APPENDS: dwf.sum(dtype=I32),
+            (mon.CTR_DISPATCH_PALLAS if use_pallas
+             else mon.CTR_DISPATCH_XLA): 1,
+        })
+        counters = mon.gauge_max(
+            counters, {mon.CTR_RING_HWM: logs.head.max()})
+        return db, new_ctx, _stats_of(c1), counters
     return db, new_ctx, _stats_of(c1)
 
 
 def build_pipelined_runner(n_accounts: int, w: int = 8192,
                            cohorts_per_block: int = 8, hot_frac=None,
-                           hot_prob=None, mix=None, use_pallas=None):
+                           hot_prob=None, mix=None, use_pallas=None,
+                           monitor: bool = False):
     """jit(scan(pipe_step)) over carry (db, c1). Returns (run, init, drain):
       run(carry, key) -> (carry', stats [cohorts_per_block, N_STATS])
       init(db)        -> carry with one bootstrap cohort in flight
@@ -325,28 +360,42 @@ def build_pipelined_runner(n_accounts: int, w: int = 8192,
 
     ``use_pallas``: None = honor DINT_USE_PALLAS env; Mosaic failure falls
     back to the XLA gathers (ops/pallas_gather.resolve_use_pallas).
+
+    ``monitor``: thread the dintmon counter plane — the carry grows a
+    trailing monitor.Counters leaf and drain returns (db, stats,
+    counters); off (default) = contract and jaxpr unchanged.
     """
     use_pallas = pg.resolve_use_pallas(use_pallas, n_idx=w * L, m_lock=None)
     kw = dict(w=w, n_accounts=n_accounts, use_pallas=use_pallas)
     kw_gen = dict(kw, hot_frac=hot_frac, hot_prob=hot_prob, mix=mix)
 
+    def step_mon(db, c1, key, cnt, **skw):
+        out = pipe_step(db, c1, key, counters=cnt, **skw)
+        return out if cnt is not None else out + (None,)
+
     def scan_fn(carry, key):
-        db, c1 = carry
-        db, new_ctx, stats = pipe_step(db, c1, key, **kw_gen)
-        return (db, new_ctx), stats
+        db, c1 = carry[:2]
+        cnt = carry[2] if monitor else None
+        db, new_ctx, stats, cnt = step_mon(db, c1, key, cnt, **kw_gen)
+        out = (db, new_ctx) + ((cnt,) if monitor else ())
+        return out, stats
 
     def block(carry, key):
         keys = jax.random.split(key, cohorts_per_block)
         return jax.lax.scan(scan_fn, carry, keys)
 
     def init(db):
-        return (db, empty_ctx(w))
+        base = (db, empty_ctx(w))
+        return base + ((mon.create(),) if monitor else ())
 
     @functools.partial(jax.jit, donate_argnums=0)
     def drain(carry):
-        db, c1 = carry
-        db, _, s1 = pipe_step(db, c1, jax.random.PRNGKey(0), gen_new=False,
-                              **kw)
+        db, c1 = carry[:2]
+        cnt = carry[2] if monitor else None
+        db, _, s1, cnt = step_mon(db, c1, jax.random.PRNGKey(0),
+                                  cnt, gen_new=False, **kw)
+        if monitor:
+            return db, jnp.stack([s1]), cnt
         return db, jnp.stack([s1])
 
     return jax.jit(block, donate_argnums=0), init, drain

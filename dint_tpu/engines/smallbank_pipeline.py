@@ -42,6 +42,7 @@ import jax
 import jax.numpy as jnp
 
 from ..clients import workloads as wl
+from ..monitor import counters as mon
 from . import smallbank
 from .types import Batch, Op, PAD_KEY, Reply
 
@@ -228,9 +229,11 @@ def _merge(owner, stacked):
     return stacked[owner, jnp.arange(r)]
 
 
-def cohort_step(stacked: smallbank.Shard, key, *, w: int, n_accounts: int):
+def cohort_step(stacked: smallbank.Shard, key, *, w: int, n_accounts: int,
+                counters: mon.Counters | None = None):
     """One full cohort of w txns against the 3 stacked replicas.
-    Returns (stacked', stats [N_STATS] i32)."""
+    Returns (stacked', stats [N_STATS] i32), with the updated Counters
+    appended when the dintmon plane is threaded (``counters``)."""
     step_v = jax.vmap(smallbank.step)
     kgen, kamt = jax.random.split(key)
     ttype, a1, a2 = gen_cohort(kgen, w, n_accounts)
@@ -309,20 +312,48 @@ def cohort_step(stacked: smallbank.Shard, key, *, w: int, n_accounts: int):
         magic_bad,
         bal_delta,
     ])
+    if counters is not None:
+        counters = mon.bump(counters, {
+            mon.CTR_STEPS: 1,
+            mon.CTR_TXN_ATTEMPTED: stats[STAT_ATTEMPTED],
+            mon.CTR_TXN_COMMITTED: stats[STAT_COMMITTED],
+            mon.CTR_AB_LOCK: stats[STAT_AB_LOCK],
+            mon.CTR_AB_LOGIC: stats[STAT_AB_LOGIC],
+            mon.CTR_MAGIC_BAD: magic_bad,
+            mon.CTR_LOCK_REQUESTS: active.sum(dtype=I32),
+            mon.CTR_LOCK_GRANTED: granted.sum(dtype=I32),
+            mon.CTR_LOCK_REJECTED: (active & ~granted).sum(dtype=I32),
+            mon.CTR_INSTALL_WRITES: do_write.sum(dtype=I32),
+            mon.CTR_LOG_APPENDS: do_write.sum(dtype=I32),
+            mon.CTR_DISPATCH_XLA: 1,
+        })
+        return stacked, stats, counters
     return stacked, stats
 
 
 def build_runner(n_accounts: int, w: int = 4096,
-                 cohorts_per_block: int = 8):
+                 cohorts_per_block: int = 8, monitor: bool = False):
     """jit(scan(cohort_step)): one dispatch runs `cohorts_per_block` cohorts.
 
     Returns run(stacked, key) -> (stacked', stats [cohorts_per_block, N_STATS]).
     State is donated — tables update in place in HBM.
+
+    ``monitor``: thread the dintmon counter plane — the carry becomes
+    (stacked, counters) and run returns it updated; off (default) =
+    contract and jaxpr unchanged.
     """
     step = functools.partial(cohort_step, w=w, n_accounts=n_accounts)
 
-    def block(stacked, key):
+    def scan_fn(carry, key):
+        if monitor:
+            stacked, cnt = carry
+            stacked, stats, cnt = step(stacked, key, counters=cnt)
+            return (stacked, cnt), stats
+        stacked, stats = step(carry, key)
+        return stacked, stats
+
+    def block(carry, key):
         keys = jax.random.split(key, cohorts_per_block)
-        return jax.lax.scan(step, stacked, keys)
+        return jax.lax.scan(scan_fn, carry, keys)
 
     return jax.jit(block, donate_argnums=0)

@@ -99,6 +99,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..monitor import counters as mon
 from ..ops import pallas_gather as pg
 from ..tables import log as logring
 from . import tatp
@@ -376,7 +377,8 @@ class Installs:
 def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
               n_sub: int, val_words: int, gen_new: bool = True, mix=None,
               emit_installs: bool = False, check_magic: bool = True,
-              use_pallas: bool = False):
+              use_pallas: bool = False,
+              counters: mon.Counters | None = None):
     """One fused device step: commit wave of c2, validate wave of c1, and
     read+lock wave of a NEW cohort — ordered commits -> reads -> locks per
     row exactly like the generic engine's phase order (engines/tatp.
@@ -393,7 +395,16 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
     chain from ~5 chained XLA ops to ~3. Outputs are bit-identical to the
     XLA path (tests/test_pallas_ops.py); builders resolve the flag via
     pg.resolve_use_pallas, which degrades to False when Mosaic rejects a
-    kernel."""
+    kernel.
+
+    ``counters`` (a monitor.Counters, or None = off): the device-resident
+    counter plane. When threaded, the step bumps the dintmon registry
+    in-step (txn outcomes from c2's completing stats, lock arbitration
+    won-vs-lost for the new cohort, validate lanes/failures for c1,
+    install/log counts, ring high-water, backend dispatch) with
+    unique-index scatter-adds and returns the updated Counters appended
+    to the result tuple. None (the default) threads no counter state and
+    leaves the jaxpr untouched."""
     p1 = n_sub + 1
     n1 = n_rows(n_sub) + 1
     sent = n1 - 1     # sentinel row: gathered by NOP lanes, never written
@@ -474,6 +485,13 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
     # ---- wave 2 of c1: validate read-set version compare ------------------
     bad = c1.is_read & (vvB != c1.vv1)
     changed = bad.any(axis=1)
+    if counters is not None:
+        # lanes of surviving RW txns checked / failed — the same lane set
+        # the generic pipeline re-reads (_validate_lanes), so the parity
+        # counters are engine-independent
+        v_alive = c1.alive[:, None]
+        v_lanes = (c1.is_read & v_alive).sum(dtype=I32)
+        v_failed = (bad & v_alive).sum(dtype=I32)
     c1 = c1.replace(alive=c1.alive & ~changed,
                     ab_validate=(c1.alive & changed).sum(dtype=I32))
 
@@ -504,6 +522,14 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
     flat_ws = ws_rows.reshape(-1)
     active = ws_active.reshape(-1)
     if use_pallas:
+        if counters is not None:
+            # the fused kernel only exposes winners; the won-vs-lost split
+            # needs the pre-arbitration stamps, read BEFORE the kernel
+            # aliases arb in place (a read-before-donate, which the
+            # dintlint aliasing pass permits; bit-identical to the XLA
+            # path's arb_old gather)
+            held = ((pg.gather_rows(db.arb, flat_ws, 1) >> K_ARB)
+                    == (t - 1))
         # fused kernel pass: gather + stamp compare + first-lane-wins
         # scatter-max + winner read-back in ONE launch, arb updated in
         # place (bit-identical to the XLA chain below — pinned in
@@ -545,13 +571,42 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
         magic_bad=magic_bad)
 
     db = db.replace(val=val, meta=meta, arb=arb, step=t + 1, log=logs)
+    if counters is not None:
+        grant_l = grant.reshape(-1)
+        counters = mon.bump(counters, {
+            mon.CTR_STEPS: 1,
+            mon.CTR_TXN_ATTEMPTED: c2.attempted,
+            mon.CTR_TXN_COMMITTED: (c2.ro_commit | c2.alive).sum(dtype=I32),
+            mon.CTR_AB_LOCK: c2.ab_lock,
+            mon.CTR_AB_MISSING: c2.ab_missing,
+            mon.CTR_AB_VALIDATE: c2.ab_validate,
+            mon.CTR_MAGIC_BAD: c2.magic_bad,
+            mon.CTR_LOCK_REQUESTS: active.sum(dtype=I32),
+            mon.CTR_LOCK_GRANTED: (active & grant_l).sum(dtype=I32),
+            mon.CTR_LOCK_REJECTED: (active & ~grant_l).sum(dtype=I32),
+            mon.CTR_LOCK_REJECT_HELD: (active & held).sum(dtype=I32),
+            mon.CTR_LOCK_REJECT_ARB:
+                (active & ~held & ~grant_l).sum(dtype=I32),
+            mon.CTR_VALIDATE_LANES: v_lanes,
+            mon.CTR_VALIDATE_FAILED: v_failed,
+            mon.CTR_INSTALL_WRITES: wmask.sum(dtype=I32),
+            mon.CTR_LOG_APPENDS: wmask.sum(dtype=I32),
+            (mon.CTR_DISPATCH_PALLAS if use_pallas
+             else mon.CTR_DISPATCH_XLA): 1,
+        })
+        counters = mon.gauge_max(
+            counters, {mon.CTR_RING_HWM: logs.head.max()})
     if emit_installs:
         inst = Installs(
             wmask=wmask, rows=c2.ws_rows.reshape(-1),
             meta=jnp.where(wmask, meta_new, U32(0)),
             val=newval, tbl=log_tbl, key=log_key,
             is_del=flags_del, ver=newver)
+        if counters is not None:
+            return db, new_ctx, c1, _stats_of(c2), inst, counters
         return db, new_ctx, c1, _stats_of(c2), inst
+    if counters is not None:
+        return db, new_ctx, c1, _stats_of(c2), counters
     return db, new_ctx, c1, _stats_of(c2)
 
 
@@ -573,42 +628,62 @@ def rebase_stamps(db: DenseDB) -> DenseDB:
 
 def build_pipelined_runner(n_sub: int, w: int = 8192, val_words: int = 10,
                            cohorts_per_block: int = 8, mix=None,
-                           check_magic: bool = True, use_pallas=None):
+                           check_magic: bool = True, use_pallas=None,
+                           monitor: bool = False):
     """jit(scan(pipe_step)) over carry (db, c1, c2); same contract as
     tatp_pipeline.build_pipelined_runner: returns (run, init, drain).
 
     ``use_pallas``: None = honor DINT_USE_PALLAS env; True/False forces.
     When requested, the Pallas kernels are probed at this runner's lane
     geometry and a Mosaic failure falls back to the XLA path with a logged
-    warning (ops/pallas_gather.resolve_use_pallas)."""
+    warning (ops/pallas_gather.resolve_use_pallas).
+
+    ``monitor``: thread the dintmon counter plane through the carry. The
+    carry grows a trailing monitor.Counters leaf (init creates it; read
+    it between dispatches with monitor.snapshot(carry[-1])) and drain
+    returns (db, stats, counters). Off (default) = contract and jaxpr
+    unchanged, outputs bit-identical."""
     assert 2 * w <= (1 << K_ARB), f"w={w} exceeds the arb slot field"
     use_pallas = pg.resolve_use_pallas(use_pallas, n_idx=2 * w * K,
                                        m_lock=2 * w, k_arb=K_ARB)
     kw = dict(w=w, n_sub=n_sub, val_words=val_words,
               check_magic=check_magic, use_pallas=use_pallas)
 
+    def step_mon(db, c1, c2, key, cnt, **skw):
+        """pipe_step + (counters or None), normalized to a fixed arity."""
+        out = pipe_step(db, c1, c2, key, counters=cnt, **skw)
+        return out if cnt is not None else out + (None,)
+
     def scan_fn(carry, key):
-        db, c1, c2 = carry
-        db, new_ctx, c1, stats = pipe_step(db, c1, c2, key, mix=mix, **kw)
-        return (db, new_ctx, c1), stats
+        db, c1, c2 = carry[:3]
+        cnt = carry[3] if monitor else None
+        db, new_ctx, c1, stats, cnt = step_mon(db, c1, c2, key, cnt,
+                                               mix=mix, **kw)
+        out = (db, new_ctx, c1) + ((cnt,) if monitor else ())
+        return out, stats
 
     def block(carry, key):
-        db, c1, c2 = carry
-        db = jax.lax.cond(db.step >= U32(REBASE_AT), rebase_stamps,
-                          lambda d: d, db)
+        db = jax.lax.cond(carry[0].step >= U32(REBASE_AT), rebase_stamps,
+                          lambda d: d, carry[0])
         keys = jax.random.split(key, cohorts_per_block)
-        return jax.lax.scan(scan_fn, (db, c1, c2), keys)
+        return jax.lax.scan(scan_fn, (db,) + carry[1:], keys)
 
     def init(db):
-        return (db, empty_ctx(w), empty_ctx(w))
+        base = (db, empty_ctx(w), empty_ctx(w))
+        return base + ((mon.create(),) if monitor else ())
 
     @functools.partial(jax.jit, donate_argnums=0)
     def drain(carry):
-        db, c1, c2 = carry
+        db, c1, c2 = carry[:3]
+        cnt = carry[3] if monitor else None
         key = jax.random.PRNGKey(0)
-        db, _, c1, s1 = pipe_step(db, c1, c2, key, gen_new=False, **kw)
-        db, _, _, s2 = pipe_step(db, empty_ctx(w), c1, key, gen_new=False,
-                                 **kw)
-        return db, jnp.stack([s1, s2])
+        db, _, c1, s1, cnt = step_mon(db, c1, c2, key, cnt,
+                                      gen_new=False, **kw)
+        db, _, _, s2, cnt = step_mon(db, empty_ctx(w), c1, key, cnt,
+                                     gen_new=False, **kw)
+        stats = jnp.stack([s1, s2])
+        if monitor:
+            return db, stats, cnt
+        return db, stats
 
     return jax.jit(block, donate_argnums=0), init, drain

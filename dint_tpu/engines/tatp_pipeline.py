@@ -42,6 +42,7 @@ import jax
 import jax.numpy as jnp
 
 from ..clients import workloads as wl
+from ..monitor import counters as mon
 from . import tatp
 from .types import Batch, Op, PAD_KEY, Reply
 
@@ -485,13 +486,23 @@ def _wave3_lanes(ctx: PipeCtx, kval, val_words: int):
 
 
 def pipe_step(stacked: tatp.Shard, c1: PipeCtx, c2: PipeCtx, key, *, w: int,
-              n_sub: int, val_words: int, gen_new: bool = True, mix=None):
+              n_sub: int, val_words: int, gen_new: bool = True, mix=None,
+              counters: mon.Counters | None = None):
     """One pipelined device step: wave 1 of a NEW cohort + wave 2 of c1 +
     wave 3 of c2, in a single vmapped engine step. Returns
     (stacked', new_ctx, c1', stats-of-c2) — c2 completes here.
 
     ``gen_new=False`` (static) feeds an empty cohort instead of generating
-    one: used to drain the pipeline at end of run."""
+    one: used to drain the pipeline at end of run.
+
+    ``counters`` (monitor.Counters | None): the dintmon counter plane;
+    bumps the engine-independent parity counters (txn outcomes, lock
+    grant/reject, validate lanes/failures, install/log counts — the same
+    definitions as engines/tatp_dense.pipe_step, so on the parity
+    workloads the two engines produce bit-identical values) and appends
+    the updated Counters to the return tuple. The held-vs-arb reject
+    split and the ring gauge are dense-engine observables and stay 0
+    here."""
     step_v = jax.vmap(tatp.step)
     kg, kv3 = jax.random.split(key)
     r = w * K
@@ -563,11 +574,32 @@ def pipe_step(stacked: tatp.Shard, c1: PipeCtx, c2: PipeCtx, key, *, w: int,
         c2.attempted,
         (c2.ro_commit | c2.alive).sum(dtype=I32),
         c2.ab_lock, c2.ab_missing, c2.ab_validate, c2.magic_bad])
+    if counters is not None:
+        dw2 = c2.ws_active & c2.alive[:, None]   # == _wave3_lanes do_write
+        counters = mon.bump(counters, {
+            mon.CTR_STEPS: 1,
+            mon.CTR_TXN_ATTEMPTED: stats[STAT_ATTEMPTED],
+            mon.CTR_TXN_COMMITTED: stats[STAT_COMMITTED],
+            mon.CTR_AB_LOCK: c2.ab_lock,
+            mon.CTR_AB_MISSING: c2.ab_missing,
+            mon.CTR_AB_VALIDATE: c2.ab_validate,
+            mon.CTR_MAGIC_BAD: c2.magic_bad,
+            mon.CTR_LOCK_REQUESTS: ws_active.sum(dtype=I32),
+            mon.CTR_LOCK_GRANTED: granted.sum(dtype=I32),
+            mon.CTR_LOCK_REJECTED: (ws_active & ~granted).sum(dtype=I32),
+            mon.CTR_VALIDATE_LANES: is_read_lane.sum(dtype=I32),
+            mon.CTR_VALIDATE_FAILED: bad_lane.sum(dtype=I32),
+            mon.CTR_INSTALL_WRITES: dw2.sum(dtype=I32),
+            mon.CTR_LOG_APPENDS: dw2.sum(dtype=I32),
+            mon.CTR_DISPATCH_XLA: 1,
+        })
+        return stacked, new_ctx, c1, stats, counters
     return stacked, new_ctx, c1, stats
 
 
 def build_pipelined_runner(n_sub: int, w: int = 4096, val_words: int = 10,
-                           cohorts_per_block: int = 8, mix=None):
+                           cohorts_per_block: int = 8, mix=None,
+                           monitor: bool = False):
     """jit(scan(pipe_step)) over carry (stacked, c1, c2): one dispatch runs
     `cohorts_per_block` pipelined cohorts; in-flight cohorts persist across
     blocks via the carry, so nothing is lost at block boundaries.
@@ -576,32 +608,47 @@ def build_pipelined_runner(n_sub: int, w: int = 4096, val_words: int = 10,
       run(carry, key) -> (carry', stats [cohorts_per_block, N_STATS])
       init(stacked)   -> carry with two bootstrap (empty) cohorts in flight
       drain(carry)    -> (stacked, stats [2, N_STATS]) flushing the pipeline
+
+    ``monitor``: thread the dintmon counter plane — the carry grows a
+    trailing monitor.Counters leaf and drain returns (stacked, stats,
+    counters); off (default) = contract and jaxpr unchanged.
     """
     kw = dict(w=w, n_sub=n_sub, val_words=val_words)
     kw_gen = dict(kw, mix=mix)
 
+    def step_mon(stacked, c1, c2, key, cnt, **skw):
+        out = pipe_step(stacked, c1, c2, key, counters=cnt, **skw)
+        return out if cnt is not None else out + (None,)
+
     def scan_fn(carry, key):
-        stacked, c1, c2 = carry
-        stacked, new_ctx, c1, stats = pipe_step(stacked, c1, c2, key,
-                                                **kw_gen)
-        return (stacked, new_ctx, c1), stats
+        stacked, c1, c2 = carry[:3]
+        cnt = carry[3] if monitor else None
+        stacked, new_ctx, c1, stats, cnt = step_mon(stacked, c1, c2, key,
+                                                    cnt, **kw_gen)
+        out = (stacked, new_ctx, c1) + ((cnt,) if monitor else ())
+        return out, stats
 
     def block(carry, key):
         keys = jax.random.split(key, cohorts_per_block)
         return jax.lax.scan(scan_fn, carry, keys)
 
     def init(stacked):
-        return (stacked, empty_ctx(w), empty_ctx(w))
+        base = (stacked, empty_ctx(w), empty_ctx(w))
+        return base + ((mon.create(),) if monitor else ())
 
     @functools.partial(jax.jit, donate_argnums=0)
     def drain(carry):
-        stacked, c1, c2 = carry
+        stacked, c1, c2 = carry[:3]
+        cnt = carry[3] if monitor else None
         key = jax.random.PRNGKey(0)
-        stacked, _, c1, s1 = pipe_step(stacked, c1, c2, key, gen_new=False,
-                                       **kw)
-        stacked, _, _, s2 = pipe_step(stacked, empty_ctx(w), c1, key,
-                                      gen_new=False, **kw)
-        return stacked, jnp.stack([s1, s2])
+        stacked, _, c1, s1, cnt = step_mon(stacked, c1, c2, key, cnt,
+                                           gen_new=False, **kw)
+        stacked, _, _, s2, cnt = step_mon(stacked, empty_ctx(w), c1, key,
+                                          cnt, gen_new=False, **kw)
+        stats = jnp.stack([s1, s2])
+        if monitor:
+            return stacked, stats, cnt
+        return stacked, stats
 
     return jax.jit(block, donate_argnums=0), init, drain
 

@@ -1,0 +1,238 @@
+"""Device-resident counter plane: the fixed registry + the Counters pytree.
+
+The reference's servers account for every hot-path event in per-CPU BPF
+map counters (grant/reject in lock_kern.c, per-cause aborts in the
+clients, ring heads in ls_kern.c) that userspace reads asynchronously.
+Here the "map" is one flat u32 device array threaded through the engine
+carry; engines bump slices of it in-step and the host fetches it at
+window boundaries. Three rules keep it honest:
+
+* **Fixed registry.** Counter IDs are module constants into ONE flat
+  array; names, kinds, and order are schema — artifacts and JSONL events
+  key on the names, so adding a counter means appending here (never
+  reordering) and documenting it in OBSERVABILITY.md.
+* **Deterministic increments.** Every update is an elementwise add of
+  reduced scalars via one `scatter-add`/`scatter-max` whose indices are a
+  static, sorted, duplicate-free Python tuple — `unique_indices=True` is
+  provably true, so the dintlint scatter_race pass accepts the counter
+  plane on the same terms as the table installs.
+* **u32 with wrap-safe draining.** Flow counters are monotonic mod 2^32;
+  the host computes window deltas in uint32 arithmetic (exact under a
+  single wrap) and accumulates totals in int64 (`delta`). Gauges
+  (`RING_HWM`) are scatter-MAX high-water marks: a window reports the
+  current value, not a difference.
+
+Counters never leave the device mid-step and are never read back inside
+jit (no `io_callback`): the purity pass stays clean and monitoring
+changes no engine output — with `monitor=False` (the default) the
+builders thread no counter state at all and the jaxpr is untouched.
+"""
+from __future__ import annotations
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+FLOW = "flow"      # monotonic accumulator (wrap-safe window deltas sum)
+GAUGE = "gauge"    # high-water mark (windows report the current value)
+
+# --------------------------------------------------------------- registry
+# (name, kind, doc). APPEND ONLY — indices are schema. The docs are what
+# `tools/dintmon.py summarize --describe` and OBSERVABILITY.md print.
+_REGISTRY: tuple[tuple[str, str, str], ...] = (
+    ("steps", FLOW,
+     "fused pipeline steps executed (scan iterations, drains included)"),
+    ("txn_attempted", FLOW,
+     "transactions dispatched, counted when their cohort completes — "
+     "reconciles with stats[STAT_ATTEMPTED]"),
+    ("txn_committed", FLOW,
+     "transactions committed — reconciles with stats[STAT_COMMITTED]"),
+    ("ab_lock", FLOW,
+     "aborts: write-set lock rejected (no-wait 2PL loss)"),
+    ("ab_missing", FLOW,
+     "aborts: required row absent / insert-exists (TATP semantics)"),
+    ("ab_validate", FLOW,
+     "aborts: OCC read-set version changed between read and validate"),
+    ("ab_logic", FLOW,
+     "aborts: SmallBank balance-logic failure (insufficient funds)"),
+    ("magic_bad", FLOW,
+     "integrity: VAL replies whose magic word mismatched"),
+    ("lock_requests", FLOW,
+     "lock lanes that requested a grant (active write slots)"),
+    ("lock_granted", FLOW, "lock lanes granted"),
+    ("lock_rejected", FLOW,
+     "lock lanes rejected = reject_held + reject_arb where the split is "
+     "observable (dense engines); generic engines bump only this total"),
+    ("lock_reject_held", FLOW,
+     "lock lanes rejected because the row/slot was stamped by an "
+     "in-flight cohort (cross-cohort conflict)"),
+    ("lock_reject_arb", FLOW,
+     "lock lanes that lost intra-batch first-wins arbitration"),
+    ("validate_lanes", FLOW,
+     "read-set lanes of surviving RW transactions re-checked at wave 2"),
+    ("validate_failed", FLOW,
+     "validate lanes whose version compare failed"),
+    ("install_writes", FLOW,
+     "rows installed at the commit wave (commit/insert/delete lanes)"),
+    ("log_appends", FLOW,
+     "log entries appended (one per logical install; replicas not "
+     "multiplied)"),
+    ("repl_push_hop1", FLOW,
+     "install records applied from the +1 ppermute hop (CommitBck)"),
+    ("repl_push_hop2", FLOW,
+     "install records applied from the +2 ppermute hop (CommitBck)"),
+    ("route_overflow", FLOW,
+     "all_to_all destination-bucket overflow lanes (sharded SmallBank)"),
+    ("ring_hwm", GAUGE,
+     "log-ring high-water mark: max monotonic lane head observed "
+     "(occupancy = min(ring_hwm, capacity))"),
+    ("dispatch_xla", FLOW,
+     "steps whose random-access ops ran the XLA path"),
+    ("dispatch_pallas", FLOW,
+     "steps whose random-access ops ran the Pallas DMA-ring kernels"),
+)
+
+ALL_NAMES: tuple[str, ...] = tuple(n for n, _, _ in _REGISTRY)
+COUNTER_KINDS: dict[str, str] = {n: k for n, k, _ in _REGISTRY}
+COUNTER_DOCS: dict[str, str] = {n: d for n, _, d in _REGISTRY}
+COUNTER_INDEX: dict[str, int] = {n: i for i, n in enumerate(ALL_NAMES)}
+N_COUNTERS = len(_REGISTRY)
+FLOW_NAMES = tuple(n for n, k, _ in _REGISTRY if k == FLOW)
+GAUGE_NAMES = tuple(n for n, k, _ in _REGISTRY if k == GAUGE)
+
+CTR_STEPS = COUNTER_INDEX["steps"]
+CTR_TXN_ATTEMPTED = COUNTER_INDEX["txn_attempted"]
+CTR_TXN_COMMITTED = COUNTER_INDEX["txn_committed"]
+CTR_AB_LOCK = COUNTER_INDEX["ab_lock"]
+CTR_AB_MISSING = COUNTER_INDEX["ab_missing"]
+CTR_AB_VALIDATE = COUNTER_INDEX["ab_validate"]
+CTR_AB_LOGIC = COUNTER_INDEX["ab_logic"]
+CTR_MAGIC_BAD = COUNTER_INDEX["magic_bad"]
+CTR_LOCK_REQUESTS = COUNTER_INDEX["lock_requests"]
+CTR_LOCK_GRANTED = COUNTER_INDEX["lock_granted"]
+CTR_LOCK_REJECTED = COUNTER_INDEX["lock_rejected"]
+CTR_LOCK_REJECT_HELD = COUNTER_INDEX["lock_reject_held"]
+CTR_LOCK_REJECT_ARB = COUNTER_INDEX["lock_reject_arb"]
+CTR_VALIDATE_LANES = COUNTER_INDEX["validate_lanes"]
+CTR_VALIDATE_FAILED = COUNTER_INDEX["validate_failed"]
+CTR_INSTALL_WRITES = COUNTER_INDEX["install_writes"]
+CTR_LOG_APPENDS = COUNTER_INDEX["log_appends"]
+CTR_REPL_PUSH_HOP1 = COUNTER_INDEX["repl_push_hop1"]
+CTR_REPL_PUSH_HOP2 = COUNTER_INDEX["repl_push_hop2"]
+CTR_ROUTE_OVERFLOW = COUNTER_INDEX["route_overflow"]
+CTR_RING_HWM = COUNTER_INDEX["ring_hwm"]
+CTR_DISPATCH_XLA = COUNTER_INDEX["dispatch_xla"]
+CTR_DISPATCH_PALLAS = COUNTER_INDEX["dispatch_pallas"]
+
+# the subset defined with IDENTICAL semantics by the dense engines and
+# the generic sort-based pipelines: on the parity workloads
+# (tests/test_tatp_dense.py's dense-vs-generic configuration) these must
+# be bit-identical across engine families. Engine-local counters
+# (held/arb reject split, ring gauge, dispatch/backend accounting,
+# replication hops) are excluded by design — the generic engines either
+# cannot observe them or implement the machinery differently.
+PARITY_NAMES: tuple[str, ...] = (
+    "txn_attempted", "txn_committed", "ab_lock", "ab_missing",
+    "ab_validate", "ab_logic", "magic_bad", "lock_requests",
+    "lock_granted", "lock_rejected", "validate_lanes", "validate_failed",
+    "install_writes", "log_appends",
+)
+
+
+@flax.struct.dataclass
+class Counters:
+    """The device-resident counter plane: one flat u32 vector, a pytree
+    leaf that rides the engine carry (donated with it, updated in place
+    in HBM)."""
+    buf: jax.Array     # u32 [N_COUNTERS]
+
+
+def create() -> Counters:
+    # fresh numpy backing so the buffer is never aliased with another
+    # donated leaf (same rule as the engines' empty_ctx)
+    return Counters(buf=jnp.asarray(np.zeros(N_COUNTERS, np.uint32)))
+
+
+def _static_update(c: Counters, updates: dict[int, jax.Array], *,
+                   reduce: str) -> Counters:
+    """One scatter over a static sorted duplicate-free index tuple.
+
+    `updates` keys are the CTR_* module constants (Python ints), so the
+    index operand is a compile-time constant with provably unique
+    entries — `unique_indices=True` is a fact, not a promise."""
+    if not updates:
+        return c
+    idx = tuple(sorted(updates))
+    assert len(idx) == len(updates)
+    vals = jnp.stack([jnp.asarray(updates[i]).astype(U32) for i in idx])
+    at = c.buf.at[jnp.asarray(idx, I32)]
+    if reduce == "add":
+        buf = at.add(vals, unique_indices=True)
+    else:
+        buf = at.max(vals, unique_indices=True)
+    return c.replace(buf=buf)
+
+
+def bump(c: Counters | None, updates: dict[int, jax.Array]):
+    """Add reduced scalars to flow counters; None passes through (so call
+    sites stay one-liners on both the monitored and unmonitored paths)."""
+    if c is None:
+        return None
+    return _static_update(c, updates, reduce="add")
+
+
+def gauge_max(c: Counters | None, updates: dict[int, jax.Array]):
+    """Raise gauge counters to new high-water marks (scatter-max)."""
+    if c is None:
+        return None
+    return _static_update(c, updates, reduce="max")
+
+
+def counters_enabled(monitor: bool) -> Counters | None:
+    """The builders' one-line gate: a Counters to thread, or None (the
+    default) in which case no counter state enters the jaxpr at all."""
+    return create() if monitor else None
+
+
+# ------------------------------------------------------------- host side
+
+
+def snapshot(counters) -> dict[str, int]:
+    """Fetch a Counters (or raw buf / stacked [D, N] per-device bufs) to a
+    {name: int} dict; stacked device axes are summed for flow counters and
+    maxed for gauges (the cross-shard reading of a high-water mark)."""
+    buf = counters.buf if isinstance(counters, Counters) else counters
+    arr = np.asarray(buf)
+    if arr.ndim == 1:
+        arr = arr[None]
+    arr = arr.reshape(-1, N_COUNTERS).astype(np.uint64)
+    out = {}
+    for name, i in COUNTER_INDEX.items():
+        col = arr[:, i]
+        out[name] = int(col.max() if COUNTER_KINDS[name] == GAUGE
+                        else col.sum())
+    return out
+
+
+def delta(cur: dict[str, int], prev: dict[str, int] | None) -> dict[str, int]:
+    """Window delta between two snapshots: flow counters subtract in
+    uint32 (exact under a single wrap per window per device); gauges
+    report the current value."""
+    out = {}
+    for name in ALL_NAMES:
+        c = cur.get(name, 0)
+        if COUNTER_KINDS[name] == GAUGE:
+            out[name] = int(c)
+        elif prev is None:
+            out[name] = int(c)
+        else:
+            out[name] = int(np.uint32(c) - np.uint32(prev.get(name, 0)))
+    return out
+
+
+def zeros_dict() -> dict[str, int]:
+    return {name: 0 for name in ALL_NAMES}

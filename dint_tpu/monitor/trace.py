@@ -1,0 +1,231 @@
+"""Host-side trace layer: schema-stable JSONL wave events + exports.
+
+The reference's clients print one metric block per run; its servers are
+probed live via bpftool map dumps. This module is the equivalent drain
+path for the device counter plane: at every window boundary the host
+fetches the ~100-byte counter vector, computes wrap-safe deltas, and
+appends one JSONL *wave event*. The stream is schema-stable so artifacts
+survive counter additions:
+
+    {"type": "meta", "schema": 1, "counters": [<every registered name>],
+     "kinds": {...}, ...caller metadata}
+    {"type": "wave", "step": i, "t": <s since start>, "dur_s": ..,
+     "batch": <txns dispatched this wave>, "counters": {name: delta} | null}
+
+`counters` is an object with EVERY registered name when monitoring is on
+and explicitly `null` when off — consumers never need to distinguish
+"absent because off" from "absent because old schema". Gauges carry the
+current high-water value, flows the window delta (counters.delta).
+
+`export_chrome_trace` converts a stream to the Chrome trace-event format
+(chrome://tracing, Perfetto): one "X" slice per wave plus "C" counter
+tracks for the headline rates. `profiler_session` is the shared
+jax.profiler hook bench.py/exp.py use to bracket a few steady-state
+blocks with a device trace.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+
+from . import counters as ctr
+
+SCHEMA = 1
+
+
+class TraceWriter:
+    """Append-only JSONL wave-event stream (one file per run)."""
+
+    def __init__(self, path: str, meta: dict | None = None):
+        self.path = path
+        self._f = open(path, "w")
+        rec = {"type": "meta", "schema": SCHEMA,
+               "counters": list(ctr.ALL_NAMES),
+               "kinds": dict(ctr.COUNTER_KINDS)}
+        rec.update(meta or {})
+        self._write(rec)
+
+    def _write(self, rec: dict):
+        self._f.write(json.dumps(rec) + "\n")
+        self._f.flush()
+
+    def wave(self, *, step: int, t: float, dur_s: float, batch: int,
+             counters: dict[str, int] | None):
+        if counters is not None:
+            # schema-stable: every registered name, every event
+            counters = {n: int(counters.get(n, 0)) for n in ctr.ALL_NAMES}
+        self._write({"type": "wave", "step": int(step),
+                     "t": round(float(t), 6), "dur_s": round(float(dur_s), 6),
+                     "batch": int(batch), "counters": counters})
+
+    def close(self):
+        if not self._f.closed:
+            self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class Monitor:
+    """Drives the drain loop: snapshot the device counters at each window
+    boundary, delta against the previous snapshot, accumulate int64
+    totals, optionally emit a wave event.
+
+    The fetch (np.asarray of the ~100-byte buf) is the only device
+    traffic and happens at the caller's cadence — per block in bench.py,
+    never inside jit."""
+
+    def __init__(self, writer: TraceWriter | None = None):
+        self.writer = writer
+        self.prev: dict[str, int] | None = None
+        self.totals: dict[str, int] = ctr.zeros_dict()
+        self._t0 = time.monotonic()
+        self._step = 0
+
+    def observe(self, counters, *, batch: int = 0,
+                dur_s: float = 0.0) -> dict[str, int]:
+        """counters: a Counters pytree / raw buf / stacked per-device buf
+        (the last element of a monitored runner's carry). Returns this
+        window's delta dict."""
+        snap = ctr.snapshot(counters)
+        d = ctr.delta(snap, self.prev)
+        self.prev = snap
+        for name in ctr.ALL_NAMES:
+            if ctr.COUNTER_KINDS[name] == ctr.GAUGE:
+                self.totals[name] = max(self.totals[name], d[name])
+            else:
+                self.totals[name] += d[name]
+        if self.writer is not None:
+            self.writer.wave(step=self._step,
+                             t=time.monotonic() - self._t0,
+                             dur_s=dur_s, batch=batch, counters=d)
+        self._step += 1
+        return d
+
+
+def read_events(path: str) -> tuple[dict, list[dict]]:
+    """Load a JSONL stream -> (meta record, wave events). Tolerates a
+    missing meta line (synthesizes one from the current registry)."""
+    meta = None
+    waves = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            if rec.get("type") == "meta" and meta is None:
+                meta = rec
+            elif rec.get("type") == "wave":
+                waves.append(rec)
+    if meta is None:
+        meta = {"type": "meta", "schema": SCHEMA,
+                "counters": list(ctr.ALL_NAMES),
+                "kinds": dict(ctr.COUNTER_KINDS)}
+    return meta, waves
+
+
+def summarize_events(meta: dict, waves: list[dict]) -> dict:
+    """Aggregate a wave stream: int64 totals per counter (gauges take the
+    max), wall/dur sums, and headline rates."""
+    kinds = meta.get("kinds", dict(ctr.COUNTER_KINDS))
+    totals: dict[str, int] = {}
+    monitored = 0
+    dur = 0.0
+    batch = 0
+    for w in waves:
+        dur += float(w.get("dur_s") or 0.0)
+        batch += int(w.get("batch") or 0)
+        c = w.get("counters")
+        if c is None:
+            continue
+        monitored += 1
+        for name, v in c.items():
+            if kinds.get(name) == ctr.GAUGE:
+                totals[name] = max(totals.get(name, 0), int(v))
+            else:
+                totals[name] = totals.get(name, 0) + int(v)
+    out = {"waves": len(waves), "monitored_waves": monitored,
+           "dur_s": round(dur, 6), "batch": batch,
+           "counters": {n: totals.get(n, 0)
+                        for n in meta.get("counters", ctr.ALL_NAMES)}
+           if monitored else None}
+    if monitored and dur > 0:
+        t = out["counters"]
+        out["rates_per_s"] = {
+            "txn_attempted": round(t.get("txn_attempted", 0) / dur, 1),
+            "txn_committed": round(t.get("txn_committed", 0) / dur, 1),
+        }
+        att = t.get("txn_attempted", 0)
+        if att:
+            out["abort_rate"] = round(
+                1.0 - t.get("txn_committed", 0) / att, 6)
+    return out
+
+
+# ------------------------------------------------------------ chrome trace
+
+
+def export_chrome_trace(events_path: str, out_path: str,
+                        counter_tracks: tuple[str, ...] = (
+                            "txn_committed", "ab_lock", "ab_validate",
+                            "ring_hwm")) -> int:
+    """Convert a wave-event stream to the Chrome trace-event JSON format:
+    one complete ("X") slice per wave on a single row + "C" counter
+    tracks for the headline counters. Returns the number of trace events
+    written. Load in chrome://tracing or https://ui.perfetto.dev."""
+    meta, waves = read_events(events_path)
+    events = [{"name": "process_name", "ph": "M", "pid": 0,
+               "args": {"name": meta.get("name", "dintmon")}}]
+    for w in waves:
+        ts = float(w["t"]) * 1e6
+        dur = max(float(w.get("dur_s") or 0.0) * 1e6, 1.0)
+        args = {"batch": w.get("batch", 0)}
+        c = w.get("counters")
+        if c:
+            args.update({k: c[k] for k in counter_tracks if k in c})
+        events.append({"name": f"wave {w['step']}", "ph": "X", "pid": 0,
+                       "tid": 0, "ts": round(ts, 3), "dur": round(dur, 3),
+                       "args": args})
+        if c:
+            for track in counter_tracks:
+                if track in c:
+                    events.append({"name": track, "ph": "C", "pid": 0,
+                                   "ts": round(ts, 3),
+                                   "args": {track: int(c[track])}})
+    with open(out_path, "w") as f:
+        json.dump({"traceEvents": events,
+                   "displayTimeUnit": "ms"}, f)
+    return len(events)
+
+
+@contextlib.contextmanager
+def profiler_session(trace_dir: str | None):
+    """Bracket a region with a jax.profiler device trace when `trace_dir`
+    is set; a no-op (and exception-transparent) otherwise. A profiler
+    failure must never void the measurement it decorates — errors are
+    swallowed into the yielded dict's 'error' field."""
+    info = {"trace_dir": trace_dir, "error": None}
+    if not trace_dir:
+        yield info
+        return
+    import jax
+
+    started = False
+    try:
+        jax.profiler.start_trace(trace_dir)
+        started = True
+    except Exception as e:              # noqa: BLE001 — best-effort hook
+        info["error"] = repr(e)[:200]
+    try:
+        yield info
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception as e:      # noqa: BLE001
+                info["error"] = repr(e)[:200]

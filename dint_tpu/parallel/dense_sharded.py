@@ -46,6 +46,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..engines import tatp_dense as td
+from ..monitor import counters as mon
 from ..ops import pallas_gather as pg
 from ..tables import log as logring
 from .sharded import SHARD_AXIS, make_mesh, pcast_varying   # noqa: F401 (re-exported)
@@ -140,7 +141,7 @@ def build_sharded_pipelined_runner(mesh: Mesh, n_shards: int,
                                    n_sub_global: int, w: int = 4096,
                                    val_words: int = 10,
                                    cohorts_per_block: int = 8, mix=None,
-                                   use_pallas=None):
+                                   use_pallas=None, monitor: bool = False):
     """jit(shard_map(scan(step)))) over stacked carry. Same contract shape
     as the single-chip runner: returns (run, init, drain) where
       run(carry, key) -> (carry', stats [cohorts_per_block, N_STATS]
@@ -152,7 +153,15 @@ def build_sharded_pipelined_runner(mesh: Mesh, n_shards: int,
     pipe_step then runs the DMA-ring kernels on ITS shard's local arrays
     (shard_map bodies see local shapes, so the kernels drop straight in).
     The availability probe runs once outside shard_map; Mosaic failure
-    falls back to the XLA path with a logged warning."""
+    falls back to the XLA path with a logged warning.
+
+    ``monitor``: thread the dintmon counter plane PER DEVICE — the carry
+    grows a trailing stacked monitor.Counters (buf [D, N_COUNTERS]; each
+    device bumps its own slice inside shard_map, with the replication
+    hops counted at the receiving device) and drain returns (state,
+    stats, counters). Flow counters sum across the device axis to the
+    psummed stats totals (monitor.snapshot does that reduction); off
+    (default) = contract and jaxpr unchanged."""
     assert 2 * w <= (1 << td.K_ARB), f"w={w} exceeds the arb slot field"
     use_pallas = pg.resolve_use_pallas(
         use_pallas, n_idx=2 * w * td.K, m_lock=2 * w, k_arb=td.K_ARB)
@@ -161,11 +170,15 @@ def build_sharded_pipelined_runner(mesh: Mesh, n_shards: int,
     kw = dict(w=w, n_sub=n_loc, val_words=val_words,
               use_pallas=use_pallas)
 
-    def local_step(state, c1, c2, key, gen_new=True):
+    def local_step(state, c1, c2, key, cnt, gen_new=True):
         dev = jax.lax.axis_index(SHARD_AXIS)
-        db, new_ctx, c1, stats, inst = td.pipe_step(
+        out = td.pipe_step(
             state.db, c1, c2, jax.random.fold_in(key, dev), mix=mix,
-            gen_new=gen_new, emit_installs=True, **kw)
+            gen_new=gen_new, emit_installs=True, counters=cnt, **kw)
+        if cnt is not None:
+            db, new_ctx, c1, stats, inst, cnt = out
+        else:
+            db, new_ctx, c1, stats, inst = out
         state = state.replace(db=db)
         # constants born inside the body (attempted, ab_validate=0) are
         # unvarying over the mesh axis; mark them varying so the scan
@@ -177,15 +190,24 @@ def build_sharded_pipelined_runner(mesh: Mesh, n_shards: int,
             perm = [(i, (i + off) % n_shards) for i in range(n_shards)]
             fwd = jax.tree.map(functools.partial(
                 jax.lax.ppermute, axis_name=SHARD_AXIS, perm=perm), inst)
+            if cnt is not None:
+                # replication pushes, counted where they are APPLIED (the
+                # receiving backup — the reference's CommitBck handler)
+                hop = (mon.CTR_REPL_PUSH_HOP1 if off == 1
+                       else mon.CTR_REPL_PUSH_HOP2)
+                cnt = mon.bump(cnt, {hop: fwd.wmask.sum(dtype=jnp.int32)})
             src_dev = (dev - off) % n_shards
             state = _apply_backup(state, fwd, off - 1, n1, val_words,
                                   src_dev)
-        return state, new_ctx, c1, jax.lax.psum(stats, SHARD_AXIS)
+        return state, new_ctx, c1, jax.lax.psum(stats, SHARD_AXIS), cnt
 
     def scan_fn(carry, key, gen_new=True):
-        state, c1, c2 = carry
-        state, new_ctx, c1, stats = local_step(state, c1, c2, key, gen_new)
-        return (state, new_ctx, c1), stats
+        state, c1, c2 = carry[:3]
+        cnt = carry[3] if monitor else None
+        state, new_ctx, c1, stats, cnt = local_step(state, c1, c2, key,
+                                                    cnt, gen_new)
+        out = (state, new_ctx, c1) + ((cnt,) if monitor else ())
+        return out, stats
 
     def sq(tree):
         return jax.tree.map(lambda x: x[0], tree)
@@ -193,52 +215,58 @@ def build_sharded_pipelined_runner(mesh: Mesh, n_shards: int,
     def unsq(tree):
         return jax.tree.map(lambda x: x[None], tree)
 
-    def block_local(state_blk, c1_blk, c2_blk, key):
-        state0 = sq(state_blk)
+    def block_local(*args):
+        key = args[-1]
+        state0 = sq(args[0])
         db = jax.lax.cond(state0.db.step >= jnp.uint32(td.REBASE_AT),
                           td.rebase_stamps, lambda d: d, state0.db)
         keys = jax.random.split(key, cohorts_per_block)
-        carry, stats = jax.lax.scan(
-            scan_fn, (state0.replace(db=db), sq(c1_blk), sq(c2_blk)), keys)
-        state, c1, c2 = carry
-        return unsq(state), unsq(c1), unsq(c2), stats
+        carry0 = (state0.replace(db=db),) + tuple(
+            sq(a) for a in args[1:-1])
+        carry, stats = jax.lax.scan(scan_fn, carry0, keys)
+        return tuple(unsq(x) for x in carry) + (stats,)
 
-    def drain_local(state_blk, c1_blk, c2_blk, key):
-        carry = (sq(state_blk), sq(c1_blk), sq(c2_blk))
+    def drain_local(*args):
+        key = args[-1]
+        carry = tuple(sq(a) for a in args[:-1])
         carry, s1 = scan_fn(carry, key, gen_new=False)
         carry, s2 = scan_fn(carry, jax.random.fold_in(key, 1),
                             gen_new=False)
-        state, _, _ = carry
-        return unsq(state), jnp.stack([s1, s2])
+        out = (unsq(carry[0]),) + ((unsq(carry[3]),) if monitor else ())
+        return out + (jnp.stack([s1, s2]),)
 
-    spec = (P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS), P())
+    n_carry = 4 if monitor else 3
+    spec = (P(SHARD_AXIS),) * n_carry + (P(),)
     block = jax.shard_map(block_local, mesh=mesh, in_specs=spec,
-                          out_specs=(P(SHARD_AXIS), P(SHARD_AXIS),
-                                     P(SHARD_AXIS), P()))
-    drain_m = jax.shard_map(drain_local, mesh=mesh, in_specs=spec,
-                            out_specs=(P(SHARD_AXIS), P()))
+                          out_specs=(P(SHARD_AXIS),) * n_carry + (P(),))
+    drain_m = jax.shard_map(
+        drain_local, mesh=mesh, in_specs=spec,
+        out_specs=(P(SHARD_AXIS),) * (2 if monitor else 1) + (P(),))
 
-    def stack_ctx():
+    def stack_leaf(one):
         shard = NamedSharding(mesh, P(SHARD_AXIS))
-        one = td.empty_ctx(w)
         return jax.tree.map(
             lambda x: jax.device_put(
                 jnp.broadcast_to(x[None], (n_shards,) + x.shape), shard),
             one)
 
-    jit_block = jax.jit(block, donate_argnums=(0, 1, 2))
-    jit_drain = jax.jit(drain_m, donate_argnums=(0, 1, 2))
+    donate = tuple(range(n_carry))
+    jit_block = jax.jit(block, donate_argnums=donate)
+    jit_drain = jax.jit(drain_m, donate_argnums=donate)
 
     def run(carry, key):
-        state, c1, c2 = carry
-        state, c1, c2, stats = jit_block(state, c1, c2, key)
-        return (state, c1, c2), stats
+        out = jit_block(*carry, key)
+        return out[:-1], out[-1]
 
     def init(state):
-        return (state, stack_ctx(), stack_ctx())
+        base = (state, stack_leaf(td.empty_ctx(w)),
+                stack_leaf(td.empty_ctx(w)))
+        return base + ((stack_leaf(mon.create()),) if monitor else ())
 
     def drain(carry):
-        state, c1, c2 = carry
-        return jit_drain(state, c1, c2, jax.random.PRNGKey(0))
+        out = jit_drain(*carry, jax.random.PRNGKey(0))
+        if monitor:
+            return out[0], out[2], out[1]
+        return out
 
     return run, init, drain

@@ -63,6 +63,7 @@ from ..engines.smallbank_pipeline import (L, TS_AMT_MAX, VW, N_STATS,
                                           STAT_BAL_DELTA, compute_phase,
                                           gen_cohort, _lock_slots)
 from ..engines.types import Op
+from ..monitor import counters as mon
 from ..ops import pallas_gather as pg
 from ..tables import log as logring
 from .sharded import SHARD_AXIS, make_mesh, pcast_varying   # noqa: F401 (re-exported)
@@ -187,14 +188,23 @@ def _stats_of(c: SBCtx):
 def build_sharded_sb_runner(mesh: Mesh, n_shards: int, n_accounts: int,
                             w: int = 2048, cohorts_per_block: int = 8,
                             hot_frac=None, hot_prob=None, mix=None,
-                            use_pallas=None):
+                            use_pallas=None, monitor: bool = False):
     """jit(shard_map(scan(step))). Contract mirrors the single-chip dense
     runner: (run, init, drain); stats are psummed across the mesh.
 
     ``use_pallas``: None = honor DINT_USE_PALLAS env; routes the owner-side
     held-stamp and balance gathers through the DMA-ring kernel
     (ops/pallas_gather.gather_rows) on each device's local arrays; Mosaic
-    failure falls back to the XLA gathers (logged warning)."""
+    failure falls back to the XLA gathers (logged warning).
+
+    ``monitor``: thread the dintmon counter plane PER DEVICE. Txn
+    outcomes count at the source device (where the cohort completes);
+    lock arbitration and installs count at the OWNER device (where they
+    execute); replication pushes count at the receiving backup; routing
+    overflow counts with the completing cohort's stats. Flow counters
+    therefore sum across the device axis to the psummed stats totals.
+    Drain returns (state, stats, counters); off (default) = contract and
+    jaxpr unchanged."""
     d = n_shards
     n_loc = n_acct_local(n_accounts, d)
     m1 = m1_local(n_accounts, d)
@@ -209,7 +219,7 @@ def build_sharded_sb_runner(mesh: Mesh, n_shards: int, n_accounts: int,
     if hot_prob is not None:
         kw_gen["hot_prob"] = hot_prob
 
-    def local_step(state: SBShard, c1: SBCtx, key, gen_new=True):
+    def local_step(state: SBShard, c1: SBCtx, key, cnt, gen_new=True):
         dev = jax.lax.axis_index(AXIS)
         t = state.step
         kgen, kamt = jax.random.split(jax.random.fold_in(key, dev))
@@ -345,20 +355,58 @@ def build_sharded_sb_runner(mesh: Mesh, n_shards: int, n_accounts: int,
             perm = [(i, (i + off) % d) for i in range(d)]
             pp = functools.partial(jax.lax.ppermute, axis_name=AXIS,
                                    perm=perm)
-            log, bck = mk_entry(pp(i_mask), pp(i_row), pp(i_bal),
+            fwd_mask = pp(i_mask)
+            if cnt is not None:
+                # replication pushes, counted where they are APPLIED
+                hop = (mon.CTR_REPL_PUSH_HOP1 if off == 1
+                       else mon.CTR_REPL_PUSH_HOP2)
+                cnt = mon.bump(cnt, {hop: fwd_mask.sum(dtype=I32)})
+            log, bck = mk_entry(fwd_mask, pp(i_row), pp(i_bal),
                                 pp(i_tbl), pp(i_acc), log, bck, off - 1,
                                 (dev - off) % d)
 
         state = state.replace(bal=bal_new, bck_bal=bck, x_step=x_step,
                               s_step=s_step, step=t + 1, log=log)
 
+        if cnt is not None:
+            # txn outcomes + overflow at the SOURCE (c1 completes here);
+            # lock arbitration + installs at the OWNER (they ran here) —
+            # either way each event is counted on exactly one device, so
+            # the device-axis sum reconciles with the psummed stats
+            req = r_op != 0
+            grant = grant_x | grant_s
+            rej = req & ~grant
+            held = held_x | held_s
+            cnt = mon.bump(cnt, {
+                mon.CTR_STEPS: 1,
+                mon.CTR_TXN_ATTEMPTED: c1.attempted,
+                mon.CTR_TXN_COMMITTED: c1.committed,
+                mon.CTR_AB_LOCK: c1.ab_lock,
+                mon.CTR_AB_LOGIC: c1.ab_logic,
+                mon.CTR_MAGIC_BAD: c1.magic_bad,
+                mon.CTR_ROUTE_OVERFLOW: c1.overflow,
+                mon.CTR_LOCK_REQUESTS: req.sum(dtype=I32),
+                mon.CTR_LOCK_GRANTED: grant.sum(dtype=I32),
+                mon.CTR_LOCK_REJECTED: rej.sum(dtype=I32),
+                mon.CTR_LOCK_REJECT_HELD: (rej & held).sum(dtype=I32),
+                mon.CTR_LOCK_REJECT_ARB: (rej & ~held).sum(dtype=I32),
+                mon.CTR_INSTALL_WRITES: i_mask.sum(dtype=I32),
+                mon.CTR_LOG_APPENDS: i_mask.sum(dtype=I32),
+                (mon.CTR_DISPATCH_PALLAS if use_pallas
+                 else mon.CTR_DISPATCH_XLA): 1,
+            })
+            cnt = mon.gauge_max(cnt, {mon.CTR_RING_HWM: log.head.max()})
+
         new_ctx = jax.tree.map(lambda x: pcast_varying(x, AXIS), new_ctx)
-        return state, new_ctx, jax.lax.psum(_stats_of(c1), AXIS)
+        return state, new_ctx, jax.lax.psum(_stats_of(c1), AXIS), cnt
 
     def scan_fn(carry, key, gen_new=True):
-        state, c1 = carry
-        state, new_ctx, stats = local_step(state, c1, key, gen_new)
-        return (state, new_ctx), stats
+        state, c1 = carry[:2]
+        cnt = carry[2] if monitor else None
+        state, new_ctx, stats, cnt = local_step(state, c1, key, cnt,
+                                                gen_new)
+        out = (state, new_ctx) + ((cnt,) if monitor else ())
+        return out, stats
 
     def sq(tree):
         return jax.tree.map(lambda x: x[0], tree)
@@ -366,44 +414,49 @@ def build_sharded_sb_runner(mesh: Mesh, n_shards: int, n_accounts: int,
     def unsq(tree):
         return jax.tree.map(lambda x: x[None], tree)
 
-    def block_local(state_blk, c1_blk, key):
+    def block_local(*args):
+        key = args[-1]
         keys = jax.random.split(key, cohorts_per_block)
-        carry, stats = jax.lax.scan(scan_fn, (sq(state_blk), sq(c1_blk)),
-                                    keys)
-        state, c1 = carry
-        return unsq(state), unsq(c1), stats
+        carry, stats = jax.lax.scan(
+            scan_fn, tuple(sq(a) for a in args[:-1]), keys)
+        return tuple(unsq(x) for x in carry) + (stats,)
 
-    def drain_local(state_blk, c1_blk, key):
-        carry, s1 = scan_fn((sq(state_blk), sq(c1_blk)), key,
+    def drain_local(*args):
+        key = args[-1]
+        carry, s1 = scan_fn(tuple(sq(a) for a in args[:-1]), key,
                             gen_new=False)
-        state, _ = carry
-        return unsq(state), jnp.stack([s1])
+        out = (unsq(carry[0]),) + ((unsq(carry[2]),) if monitor else ())
+        return out + (jnp.stack([s1]),)
 
-    spec = (P(AXIS), P(AXIS), P())
+    n_carry = 3 if monitor else 2
+    spec = (P(AXIS),) * n_carry + (P(),)
     block = jax.shard_map(block_local, mesh=mesh, in_specs=spec,
-                          out_specs=(P(AXIS), P(AXIS), P()))
-    drain_m = jax.shard_map(drain_local, mesh=mesh, in_specs=spec,
-                            out_specs=(P(AXIS), P()))
-    jit_block = jax.jit(block, donate_argnums=(0, 1))
-    jit_drain = jax.jit(drain_m, donate_argnums=(0, 1))
+                          out_specs=(P(AXIS),) * n_carry + (P(),))
+    drain_m = jax.shard_map(
+        drain_local, mesh=mesh, in_specs=spec,
+        out_specs=(P(AXIS),) * (2 if monitor else 1) + (P(),))
+    donate = tuple(range(n_carry))
+    jit_block = jax.jit(block, donate_argnums=donate)
+    jit_drain = jax.jit(drain_m, donate_argnums=donate)
 
-    def stack_ctx():
+    def stack_leaf(one):
         shard = NamedSharding(mesh, P(AXIS))
-        one = _empty_sb_ctx(w)
         return jax.tree.map(
             lambda x: jax.device_put(
                 jnp.broadcast_to(x[None], (d,) + x.shape), shard), one)
 
     def run(carry, key):
-        state, c1 = carry
-        state, c1, stats = jit_block(state, c1, key)
-        return (state, c1), stats
+        out = jit_block(*carry, key)
+        return out[:-1], out[-1]
 
     def init(state):
-        return (state, stack_ctx())
+        base = (state, stack_leaf(_empty_sb_ctx(w)))
+        return base + ((stack_leaf(mon.create()),) if monitor else ())
 
     def drain(carry):
-        state, c1 = carry
-        return jit_drain(state, c1, jax.random.PRNGKey(0))
+        out = jit_drain(*carry, jax.random.PRNGKey(0))
+        if monitor:
+            return out[0], out[2], out[1]
+        return out
 
     return run, init, drain

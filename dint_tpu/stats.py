@@ -109,9 +109,21 @@ class LatencyReservoir:
         self.n_seen += n
 
     def percentiles(self):
-        if self.n_kept == 0:
-            return dict(avg=0.0, p50=0.0, p99=0.0, p999=0.0)
+        """Metric dict, DEFINED at every fill level (tests/test_stats.py):
+
+        * empty reservoir -> all zeros (a window that measured nothing
+          reports 0, never NaN — the reference prints 0 lat lines too);
+        * n == 1 -> every percentile equals the sample (linear
+          interpolation over one point degenerates to it);
+        * non-finite samples (a NaN/inf fed by a timing glitch) are
+          EXCLUDED rather than poisoning every percentile — np.percentile
+          propagates NaN through the whole vector otherwise.
+        """
         s = self.samples[: self.n_kept]
+        if len(s):
+            s = s[np.isfinite(s)]
+        if len(s) == 0:
+            return dict(avg=0.0, p50=0.0, p99=0.0, p999=0.0)
         p50, p99, p999 = np.percentile(s, [50, 99, 99.9])
         return dict(avg=float(s.mean()), p50=float(p50), p99=float(p99),
                     p999=float(p999))

@@ -57,6 +57,24 @@ def _percentiles(samples_us):
     return lat.percentiles()
 
 
+def _monitor_on() -> bool:
+    """DINT_MONITOR=1 threads the dintmon counter plane through every
+    pipeline sweep point; each point's artifact then embeds the counter
+    snapshot (explicit null otherwise — OBSERVABILITY.md)."""
+    return os.environ.get("DINT_MONITOR") == "1"
+
+
+def _drain(drain, carry):
+    """Drain a runner, tolerating both contracts: (state, stats) and the
+    monitored (state, stats, counters). Returns (tail_stats, snapshot)."""
+    out = drain(carry)
+    if len(out) == 3:
+        from dint_tpu import monitor as dm
+
+        return out[1], dm.snapshot(out[2])
+    return out[1], None
+
+
 def pipeline_closed(run, carry, drain, n_stats, *, window_s, cpb,
                     depth, magic_idx, key_seed=0):
     """Closed-loop window over a fused pipelined runner.
@@ -70,21 +88,27 @@ def pipeline_closed(run, carry, drain, n_stats, *, window_s, cpb,
 
     from dint_tpu import stats as st
 
+    from dint_tpu.monitor import trace as mtrace
+
     key = jax.random.PRNGKey(key_seed)
     s0 = np.zeros(n_stats, np.int64)
     for warm_key in (999_999, 999_998):   # fresh + donated-carry layouts
         carry, s = run(carry, jax.random.fold_in(key, warm_key))
         s0 += np.asarray(s, np.int64).sum(axis=0)  # fetch = sync
     cpu = st.CpuMonitor()   # strictly over the timed window
-    carry, total, warm, dt, _blocks, block_s = st.run_window(
-        run, carry, key, window_s, n_stats, warmup_blocks=0)
+    # DINT_EXP_TRACE_DIR: bracket every closed window with a jax.profiler
+    # device trace (one timestamped session per point lands in the dir);
+    # a profiler failure never voids the measurement
+    with mtrace.profiler_session(os.environ.get("DINT_EXP_TRACE_DIR")):
+        carry, total, warm, dt, _blocks, block_s = st.run_window(
+            run, carry, key, window_s, n_stats, warmup_blocks=0)
     cores = cpu.cores()
-    _, tail = drain(carry)
+    tail, counters = _drain(drain, carry)
     total = total + np.asarray(tail, np.int64).sum(axis=0)
     if int(s0[magic_idx] + warm[magic_idx] + total[magic_idx]) != 0:
         raise RuntimeError("magic-byte integrity violated (incl. warmup)")
     p = st.cohort_latency_percentiles(block_s, cpb, depth)
-    return total, dt, p, cores
+    return total, dt, p, cores, counters
 
 
 def pipeline_open(make_runner, n_stats, *, rate, window_s, w, cpb, depth,
@@ -125,7 +149,7 @@ def pipeline_open(make_runner, n_stats, *, rate, window_s, w, cpb, depth,
         lat_blocks.append(np.maximum(done - arr, 0.0) * 1e6)
         i += 1
     dt = time.time() - t0
-    _, tail = drain(carry)
+    tail, _ = _drain(drain, carry)
     total += np.asarray(tail, np.int64).sum(axis=0)
     p = _percentiles(lat_blocks)
     offered = i * cpb * w / dt
@@ -150,7 +174,8 @@ def _tatp_runner(n_sub, w, cpb, seed=0):
         db = td.populate_device(jax.random.PRNGKey(seed), n_sub,
                                 val_words=10)
         run, init, drain = td.build_pipelined_runner(
-            n_sub, w=w, val_words=10, cohorts_per_block=cpb, use_pallas=up)
+            n_sub, w=w, val_words=10, cohorts_per_block=cpb, use_pallas=up,
+            monitor=_monitor_on())
         carry = init(db)
         if up:
             # force the full-geometry compile NOW: a Mosaic failure the
@@ -195,7 +220,8 @@ def _sb_runner(n_acc, w, cpb):
     def build(up):
         db = sd.create(n_acc)
         run, init, drain = sd.build_pipelined_runner(
-            n_acc, w=w, cohorts_per_block=cpb, use_pallas=up)
+            n_acc, w=w, cohorts_per_block=cpb, use_pallas=up,
+            monitor=_monitor_on())
         carry = init(db)
         if up:
             # same full-geometry degrade rule as _tatp_runner
@@ -272,13 +298,15 @@ def sweep_pipeline(name, runner_fn, extras_fn, n_stats, *, widths, cpb,
     def closed_point(w):
         def fn():
             run, carry, drain = runner_fn(w, cpb)
-            total, dt, p, cores = pipeline_closed(
+            total, dt, p, cores, counters = pipeline_closed(
                 run, carry, drain, n_stats, window_s=window_s, cpb=cpb,
                 depth=depth, magic_idx=magic_idx)
             att, com, extra = extras_fn(total)
             extra.update(cores)
             extra["mode"] = "closed"
             extra["width"] = w
+            # end-of-point dintmon snapshot; explicit null when off
+            extra["counters"] = counters
             return _metric_json(att, com, dt, p, extra)
 
         return fn
@@ -326,7 +354,7 @@ def sweep_pipeline(name, runner_fn, extras_fn, n_stats, *, widths, cpb,
             carry, total, dt, steps, p = st.run_latency_window(
                 run, carry, jax.random.PRNGKey(7), window_s, n_stats,
                 depth=depth)
-            _, tail = drain(carry)
+            tail, _ = _drain(drain, carry)
             total = total + np.asarray(tail, np.int64).sum(axis=0)
             att, com, extra = extras_fn(total)
             extra.update(mode="latency_measured", width=w, cpb=1,

@@ -1,0 +1,83 @@
+"""stats.LatencyReservoir: percentile edge behavior + interpolation.
+
+The reservoir is the latency store behind every metric block (bench.py,
+exp.py, the wire clients). Its percentile contract must be total: an
+empty window, a single sample, and glitch-poisoned (non-finite) samples
+all return defined numbers — a NaN in a published p99 is how a
+measurement silently stops being auditable.
+"""
+import numpy as np
+import pytest
+
+from dint_tpu.stats import LatencyReservoir, cohort_latency_percentiles
+
+
+def test_empty_reservoir_returns_zeros_not_nan():
+    p = LatencyReservoir().percentiles()
+    assert p == dict(avg=0.0, p50=0.0, p99=0.0, p999=0.0)
+    assert all(np.isfinite(v) for v in p.values())
+
+
+def test_single_sample_defines_every_percentile():
+    lat = LatencyReservoir()
+    lat.add(42.5)
+    p = lat.percentiles()
+    assert p["avg"] == p["p50"] == p["p99"] == p["p999"] == 42.5
+
+
+def test_two_samples_interpolate_linearly():
+    lat = LatencyReservoir()
+    lat.add(np.array([0.0, 100.0]))
+    p = lat.percentiles()
+    assert p["p50"] == pytest.approx(50.0)
+    assert p["p99"] == pytest.approx(99.0)
+    assert p["p999"] == pytest.approx(99.9)
+
+
+def test_percentile_interpolation_matches_numpy_linear():
+    # 1..1000: the linear ("nth fractional rank") interpolation values
+    # are closed-form: p at q = 1 + q/100 * 999
+    lat = LatencyReservoir()
+    s = np.arange(1, 1001, dtype=np.float64)
+    lat.add(s)
+    p = lat.percentiles()
+    assert p["p50"] == pytest.approx(1 + 0.50 * 999)    # 500.5
+    assert p["p99"] == pytest.approx(1 + 0.99 * 999)    # 990.01
+    assert p["p999"] == pytest.approx(1 + 0.999 * 999)  # 999.001
+    assert p["avg"] == pytest.approx(s.mean())
+    # and p50 <= p99 <= p99.9 always
+    assert p["p50"] <= p["p99"] <= p["p999"]
+
+
+def test_non_finite_samples_are_excluded():
+    lat = LatencyReservoir()
+    lat.add(np.array([1.0, np.nan, 2.0, np.inf, 3.0]))
+    p = lat.percentiles()
+    assert all(np.isfinite(v) for v in p.values())
+    assert p["p50"] == 2.0
+    assert p["avg"] == pytest.approx(2.0)
+    # all-non-finite degrades to the empty contract, not NaN
+    lat2 = LatencyReservoir()
+    lat2.add(np.array([np.nan, np.nan]))
+    assert lat2.percentiles() == dict(avg=0.0, p50=0.0, p99=0.0, p999=0.0)
+
+
+def test_reservoir_downsampling_keeps_percentiles_defined():
+    lat = LatencyReservoir(cap=256, seed=0)
+    lat.add(np.full(10_000, 7.0))
+    assert lat.n_kept == 256 and lat.n_seen == 10_000
+    p = lat.percentiles()
+    assert p["p50"] == p["p999"] == 7.0
+
+
+def test_empty_add_is_a_noop():
+    lat = LatencyReservoir()
+    lat.add(np.array([]))
+    assert lat.n_seen == 0
+    assert lat.percentiles()["p99"] == 0.0
+
+
+def test_cohort_latency_percentiles_empty_blocks():
+    out = cohort_latency_percentiles([], cohorts_per_block=4, depth=3)
+    assert out["n"] == 0
+    assert out["p99"] == 0.0 and np.isfinite(out["p999"])
