@@ -289,6 +289,10 @@ def _child_main():
         # object when DINT_MONITOR=1, EXPLICIT null otherwise — consumers
         # never need to distinguish "off" from "old artifact schema"
         "counters": counters_out,
+        # dintlint --all --json verdict the round ran under (same
+        # object-or-explicit-null contract; filled in below so the gate
+        # subprocess runs after the measurement window, not inside it)
+        "dintlint": None,
         **({} if check_magic else {"integrity_checks": "off (A/B knob)"}),
         "blocks": blocks,
         "window_s": round(dt, 2),
@@ -316,6 +320,12 @@ def _child_main():
     print(json.dumps(out), flush=True)
     print(f"attempted={attempted} blocks={blocks} window_s={dt:.2f}",
           file=sys.stderr)
+    # gate snapshot AFTER the headline is safe on stdout: a hung/slow lint
+    # subprocess can only cost the enriched line, never the measurement
+    lint, lint_err = _dintlint_snapshot()
+    out["dintlint"] = lint
+    if lint_err:
+        out["dintlint_error"] = lint_err
     if os.environ.get("DINT_BENCH_SKIP_SB") == "1":
         # short-budget retry child (see TOTAL_BUDGET_S): the parent asked
         # us to skip the secondary leg rather than lose it to the timeout
@@ -326,6 +336,35 @@ def _child_main():
         except Exception as e:  # secondary metric must not kill the headline
             out["smallbank_error"] = repr(e)[:200]
     print(json.dumps(out), flush=True)
+
+
+def _dintlint_snapshot():
+    """`dintlint --all --json` in a CPU subprocess so every perf artifact
+    records the static-analysis gate state it ran under (ANALYSIS.md) —
+    a number measured on an engine whose protocol checks were red is not
+    a number. Returns (payload-or-None, error-or-None); a missing/failed
+    gate run never voids the measurement (DINT_BENCH_LINT=0 disables)."""
+    if os.environ.get("DINT_BENCH_LINT", "1") == "0":
+        return None, "disabled (DINT_BENCH_LINT=0)"
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "dintlint.py")
+    timeout = float(os.environ.get("DINT_BENCH_LINT_TIMEOUT", "420"))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")   # gate runs CPU-only
+    try:
+        c = subprocess.run([sys.executable, tool, "--all", "--json"],
+                           capture_output=True, text=True, env=env,
+                           timeout=timeout)
+        lines = [ln for ln in c.stdout.splitlines() if ln.startswith("{")]
+        if not lines:
+            return None, (f"dintlint rc={c.returncode}, no JSON line; "
+                          f"stderr tail: {c.stderr.strip()[-200:]}")
+        payload = json.loads(lines[-1])
+        # artifacts keep the verdict + counts; the full finding list is
+        # reproducible from the committed tree and only bloats the JSON
+        payload.pop("findings", None)
+        return payload, None
+    except Exception as e:  # noqa: BLE001 — gate failure must not kill bench
+        return None, repr(e)[:200]
 
 
 def _bench_smallbank():

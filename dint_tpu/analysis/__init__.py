@@ -20,19 +20,30 @@ Library API:
 """
 from __future__ import annotations
 
+import time as _time
+
 from . import passes as _passes          # noqa: F401 — registers the passes
 from . import allowlist as _allowlist
 from .core import (Finding, PASS_DOCS, PASSES, SEV_ERROR, SEV_INFO,  # noqa: F401
                    SEV_WARNING, TargetTrace, trace_target)
-from .targets import TARGET_DOCS, TARGETS, SkipTarget, get_trace  # noqa: F401
+from .targets import (TARGET_DOCS, TARGET_PROTOCOL, TARGETS,  # noqa: F401
+                      TRACE_CACHE, SkipTarget, get_trace)
 
 
 def run(targets=None, passes=None, allowlist_path: str | None = None,
-        allowlist_entries=None) -> list[Finding]:
+        allowlist_entries=None, timings: dict | None = None
+        ) -> list[Finding]:
     """Trace the requested targets, run the requested passes, apply the
     allowlist. Unknown names raise KeyError (the CLI turns that into a
     usage error); a target whose prerequisites are missing (device count)
-    yields one INFO finding instead of failing the run."""
+    yields one INFO finding instead of failing the run.
+
+    Traces are built ONCE per process (targets.TRACE_CACHE) and shared by
+    every pass and every run() call. Pass a dict as ``timings`` to get
+    per-target wall time filled in:
+    {"total_s", "targets": {name: {"trace_s", "cached", "passes": {...}}}}
+    — trace_s is the build cost (0-ish on cache hits, flagged "cached"),
+    so tier-1 budget regressions in the matrix are attributable."""
     target_names = list(targets) if targets else list(TARGETS)
     pass_names = list(passes) if passes else list(PASSES)
     for name in target_names:
@@ -44,8 +55,10 @@ def run(targets=None, passes=None, allowlist_path: str | None = None,
             raise KeyError(f"unknown pass {name!r}; known: "
                            f"{sorted(PASSES)}")
 
+    t_all = _time.perf_counter()
     findings: list[Finding] = []
     for tname in target_names:
+        cached = tname in TRACE_CACHE
         try:
             trace = get_trace(tname)
         except SkipTarget as e:
@@ -63,8 +76,19 @@ def run(targets=None, passes=None, allowlist_path: str | None = None,
                            "entry point moved, update "
                            "dint_tpu/analysis/targets.py"))
             continue
+        per_pass: dict[str, float] = {}
         for pname in pass_names:
+            t0 = _time.perf_counter()
             findings.extend(PASSES[pname](trace))
+            per_pass[pname] = round(_time.perf_counter() - t0, 4)
+        if timings is not None:
+            timings.setdefault("targets", {})[tname] = {
+                "trace_s": round(TRACE_CACHE.seconds.get(tname, 0.0), 4),
+                "cached": cached,
+                "passes": per_pass,
+            }
+    if timings is not None:
+        timings["total_s"] = round(_time.perf_counter() - t_all, 4)
     findings = _dedup(findings)
 
     entries = list(allowlist_entries) if allowlist_entries else []

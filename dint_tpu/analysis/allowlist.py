@@ -64,6 +64,33 @@ def _matches(entry: dict, f: Finding) -> bool:
     return True
 
 
+def prune_entries(entries: list[dict]) -> tuple[list[dict], list[dict]]:
+    """Split entries into (kept, dropped) by the `_used` flag `apply` set:
+    an entry that matched no finding on a FULL run is stale and gets
+    dropped. Callers must have run apply() over the complete matrix first
+    — pruning against a subset run would drop entries whose findings
+    simply were not traced."""
+    kept = [e for e in entries if e.get("_used")]
+    dropped = [e for e in entries if not e.get("_used")]
+    return kept, dropped
+
+
+def save(path: str, entries: list[dict]) -> None:
+    """Rewrite an allowlist file (private `_`-prefixed bookkeeping keys
+    stripped), one entry per line like the hand-maintained original."""
+    clean = [{k: v for k, v in e.items() if not k.startswith("_")}
+             for e in entries]
+    with open(path, "w") as f:
+        if not clean:
+            f.write("[]\n")
+            return
+        f.write("[\n")
+        for i, e in enumerate(clean):
+            sep = "," if i + 1 < len(clean) else ""
+            f.write("  " + json.dumps(e) + sep + "\n")
+        f.write("]\n")
+
+
 def apply(findings: list[Finding], entries: list[dict],
           check_unused: bool = True) -> list[Finding]:
     """Mark findings matched by an entry as allowed (in place) and append

@@ -32,6 +32,7 @@ Design notes:
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Callable, Iterator
 
 import jax
@@ -90,11 +91,18 @@ class Finding:
 @dataclasses.dataclass
 class TargetTrace:
     """A traced target: the closed jaxpr (or the trace failure) + metadata
-    the passes key on (declared mesh axes for the sharded paths)."""
+    the passes key on: declared mesh axes for the sharded paths, and the
+    protocol flags the dataflow pass gates on (passes/protocol.py) —
+    "certified" (the engine closes the lock/validate/install loop inside
+    the trace), "occ" (installs must also descend from the validate
+    compare), "replicated" (ICI replication must push and land), "drain"
+    (boundary cohorts: only the abort-unlock witness applies), "server"
+    (protocol sequencing lives in the client, outside the trace)."""
     name: str
     closed_jaxpr: jcore.ClosedJaxpr | None
     trace_error: BaseException | None = None
     mesh_axes: tuple[str, ...] = ()   # axes the target DECLARES it runs on
+    protocol: tuple[str, ...] = ("certified",)
 
     @property
     def jaxpr(self) -> jcore.Jaxpr | None:
@@ -102,6 +110,7 @@ class TargetTrace:
 
 
 def trace_target(name: str, fn: Callable, args, *, mesh_axes=(),
+                 protocol: tuple[str, ...] = ("certified",),
                  ) -> TargetTrace:
     """Trace `fn(*args)` to a jaxpr with abstract values; a trace failure
     (concretization, host sync, data-dependent Python branching) is
@@ -110,8 +119,45 @@ def trace_target(name: str, fn: Callable, args, *, mesh_axes=(),
         closed = jax.make_jaxpr(fn)(*args)
     except Exception as e:          # noqa: BLE001 — any trace failure is data
         return TargetTrace(name, None, trace_error=e,
-                           mesh_axes=tuple(mesh_axes))
-    return TargetTrace(name, closed, mesh_axes=tuple(mesh_axes))
+                           mesh_axes=tuple(mesh_axes),
+                           protocol=tuple(protocol))
+    return TargetTrace(name, closed, mesh_axes=tuple(mesh_axes),
+                       protocol=tuple(protocol))
+
+
+class TraceCache:
+    """Trace-once cache: every pass of every `analysis.run()` call in a
+    process shares ONE jaxpr per target (tracing a dense multi-chip
+    runner costs ~1 s; the matrix cost must scale with targets, not
+    targets x passes x runs). Records per-target build seconds so the
+    CLI's `--time` report can show where the wall time went."""
+
+    def __init__(self):
+        self._traces: dict[str, TargetTrace] = {}
+        self.seconds: dict[str, float] = {}   # trace-build time (misses)
+        self.hits = 0
+        self.misses = 0
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._traces
+
+    def get(self, name: str, builder: Callable[[], TargetTrace]
+            ) -> TargetTrace:
+        hit = self._traces.get(name)
+        if hit is not None:
+            self.hits += 1
+            return hit
+        self.misses += 1
+        t0 = time.perf_counter()
+        trace = builder()
+        self.seconds[name] = time.perf_counter() - t0
+        self._traces[name] = trace
+        return trace
+
+    def clear(self):
+        self._traces.clear()
+        self.seconds.clear()
+        self.hits = self.misses = 0
 
 
 # --------------------------------------------------------------- walking

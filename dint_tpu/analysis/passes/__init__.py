@@ -9,9 +9,12 @@ walking machinery and ANALYSIS.md for the invariant catalogue):
   purity             a step is one pure device program
   u64_overflow       packed stamps stay unsigned 32-bit
   shard_consistency  collectives agree with the mesh
+  protocol           lock-dominates-write / validate-before-install /
+                     abort-implies-unlock / commit-after-replication,
+                     proven by the dataflow layer (analysis/dataflow.py)
 
 Adding a pass: write `passes/<name>.py`, decorate the entry point with
 `@core.register_pass("<name>")`, import it here.
 """
-from . import (aliasing, purity, scatter_race, shard_consistency,  # noqa: F401
-               u64_overflow)
+from . import (aliasing, protocol, purity, scatter_race,  # noqa: F401
+               shard_consistency, u64_overflow)

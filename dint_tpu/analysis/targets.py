@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .core import TargetTrace, trace_target
+from .core import TargetTrace, TraceCache, trace_target
 
 U32 = jnp.uint32
 
@@ -49,16 +49,27 @@ _LOGCAP = 128
 
 TARGETS: dict[str, Callable[[], TargetTrace]] = {}
 TARGET_DOCS: dict[str, str] = {}
+# protocol flags per target (core.TargetTrace.protocol; gates the checks
+# in passes/protocol.py): "certified" = the engine closes the
+# lock/validate/install loop inside the trace; "occ" = installs must
+# also descend from the validate compare; "replicated" = ICI replication
+# must push AND land; "drain" = installs boundary cohorts certified in
+# the block trace (only abort-implies-unlock applies); "server" = the
+# client owns protocol sequencing (clients/tatp_client.py), so only
+# replication is checkable in-trace.
+TARGET_PROTOCOL: dict[str, tuple[str, ...]] = {}
 
 
 class SkipTarget(Exception):
     """Raised by a builder whose prerequisites (device count) are absent."""
 
 
-def register_target(name: str, doc: str):
+def register_target(name: str, doc: str,
+                    protocol: tuple[str, ...] = ("certified",)):
     def deco(fn):
         TARGETS[name] = fn
         TARGET_DOCS[name] = doc
+        TARGET_PROTOCOL[name] = tuple(protocol)
         return fn
     return deco
 
@@ -102,19 +113,22 @@ def _tatp_dense(name: str, use_pallas: bool,
 
 
 @register_target("tatp_dense/block",
-                 "flagship dense TATP fused 3-wave pipeline (XLA route)")
+                 "flagship dense TATP fused 3-wave pipeline (XLA route)",
+                 protocol=('certified', 'occ'))
 def _t_tatp_dense() -> TargetTrace:
     return _tatp_dense("tatp_dense/block", use_pallas=False)
 
 
 @register_target("tatp_dense/block@pallas",
-                 "dense TATP with the DMA-ring kernels (DINT_USE_PALLAS=1)")
+                 "dense TATP with the DMA-ring kernels (DINT_USE_PALLAS=1)",
+                 protocol=('certified', 'occ'))
 def _t_tatp_dense_pl() -> TargetTrace:
     return _tatp_dense("tatp_dense/block@pallas", use_pallas=True)
 
 
 @register_target("tatp_dense/block@mon",
-                 "dense TATP with the dintmon counter plane threaded")
+                 "dense TATP with the dintmon counter plane threaded",
+                 protocol=('certified', 'occ'))
 def _t_tatp_dense_mon() -> TargetTrace:
     return _tatp_dense("tatp_dense/block@mon", use_pallas=False,
                        monitor=True)
@@ -122,14 +136,16 @@ def _t_tatp_dense_mon() -> TargetTrace:
 
 @register_target("tatp_dense/block@mon+pallas",
                  "dense TATP: counter plane + DMA-ring kernels (proves the "
-                 "pre-kernel held-stamp read passes the aliasing pass)")
+                 "pre-kernel held-stamp read passes the aliasing pass)",
+                 protocol=('certified', 'occ'))
 def _t_tatp_dense_mon_pl() -> TargetTrace:
     return _tatp_dense("tatp_dense/block@mon+pallas", use_pallas=True,
                        monitor=True)
 
 
 @register_target("tatp_dense/drain",
-                 "dense TATP pipeline drain (gen_new=False tail steps)")
+                 "dense TATP pipeline drain (gen_new=False tail steps)",
+                 protocol=('drain',))
 def _t_tatp_dense_drain() -> TargetTrace:
     from ..engines import tatp_dense as td
     drain = td.build_pipelined_runner(_N_SUB, w=_W, val_words=_VW,
@@ -158,19 +174,22 @@ def _sb_dense(name: str, use_pallas: bool,
 
 
 @register_target("smallbank_dense/block",
-                 "dense SmallBank fused 2-wave pipeline (XLA route)")
+                 "dense SmallBank fused 2-wave pipeline (XLA route)",
+                 protocol=('certified',))
 def _t_sb_dense() -> TargetTrace:
     return _sb_dense("smallbank_dense/block", use_pallas=False)
 
 
 @register_target("smallbank_dense/block@pallas",
-                 "dense SmallBank with the DMA-ring gathers")
+                 "dense SmallBank with the DMA-ring gathers",
+                 protocol=('certified',))
 def _t_sb_dense_pl() -> TargetTrace:
     return _sb_dense("smallbank_dense/block@pallas", use_pallas=True)
 
 
 @register_target("smallbank_dense/block@mon",
-                 "dense SmallBank with the dintmon counter plane threaded")
+                 "dense SmallBank with the dintmon counter plane threaded",
+                 protocol=('certified',))
 def _t_sb_dense_mon() -> TargetTrace:
     return _sb_dense("smallbank_dense/block@mon", use_pallas=False,
                      monitor=True)
@@ -194,13 +213,15 @@ def _tatp_pipeline(name: str, monitor: bool = False) -> TargetTrace:
 
 
 @register_target("tatp_pipeline/block",
-                 "generic (sort-based) fused TATP pipeline")
+                 "generic (sort-based) fused TATP pipeline",
+                 protocol=('certified', 'occ'))
 def _t_tatp_pipeline() -> TargetTrace:
     return _tatp_pipeline("tatp_pipeline/block")
 
 
 @register_target("tatp_pipeline/block@mon",
-                 "generic TATP pipeline with the counter plane threaded")
+                 "generic TATP pipeline with the counter plane threaded",
+                 protocol=('certified', 'occ'))
 def _t_tatp_pipeline_mon() -> TargetTrace:
     return _tatp_pipeline("tatp_pipeline/block@mon", monitor=True)
 
@@ -216,13 +237,15 @@ def _sb_pipeline(name: str, monitor: bool = False) -> TargetTrace:
 
 
 @register_target("smallbank_pipeline/block",
-                 "generic (sort-based) fused SmallBank pipeline")
+                 "generic (sort-based) fused SmallBank pipeline",
+                 protocol=('certified',))
 def _t_sb_pipeline() -> TargetTrace:
     return _sb_pipeline("smallbank_pipeline/block")
 
 
 @register_target("smallbank_pipeline/block@mon",
-                 "generic SmallBank pipeline with the counter plane")
+                 "generic SmallBank pipeline with the counter plane",
+                 protocol=('certified',))
 def _t_sb_pipeline_mon() -> TargetTrace:
     return _sb_pipeline("smallbank_pipeline/block@mon", monitor=True)
 
@@ -262,13 +285,15 @@ def _generic_sharded(name: str, engine: str) -> TargetTrace:
 
 
 @register_target("sharded/tatp",
-                 "generic replicated TATP shard step (3-role shard_map)")
+                 "generic replicated TATP shard step (3-role shard_map)",
+                 protocol=('server', 'replicated'))
 def _t_sharded_tatp() -> TargetTrace:
     return _generic_sharded("sharded/tatp", "tatp")
 
 
 @register_target("sharded/smallbank",
-                 "generic replicated SmallBank shard step")
+                 "generic replicated SmallBank shard step",
+                 protocol=('server', 'replicated'))
 def _t_sharded_sb() -> TargetTrace:
     return _generic_sharded("sharded/smallbank", "smallbank")
 
@@ -292,20 +317,23 @@ def _dense_sharded(name: str, use_pallas: bool,
 
 @register_target("dense_sharded/block",
                  "multi-chip dense TATP: shard_map pipeline + CommitBck "
-                 "ppermute fan-out")
+                 "ppermute fan-out",
+                 protocol=('certified', 'occ', 'replicated'))
 def _t_dense_sharded() -> TargetTrace:
     return _dense_sharded("dense_sharded/block", use_pallas=False)
 
 
 @register_target("dense_sharded/block@pallas",
                  "multi-chip dense TATP with DMA-ring kernels inside the "
-                 "shard_map body")
+                 "shard_map body",
+                 protocol=('certified', 'occ', 'replicated'))
 def _t_dense_sharded_pl() -> TargetTrace:
     return _dense_sharded("dense_sharded/block@pallas", use_pallas=True)
 
 
 @register_target("dense_sharded/block@mon",
-                 "multi-chip dense TATP with per-device counter planes")
+                 "multi-chip dense TATP with per-device counter planes",
+                 protocol=('certified', 'occ', 'replicated'))
 def _t_dense_sharded_mon() -> TargetTrace:
     return _dense_sharded("dense_sharded/block@mon", use_pallas=False,
                           monitor=True)
@@ -324,25 +352,30 @@ def _dense_sharded_sb(name: str, monitor: bool = False) -> TargetTrace:
 
 
 @register_target("dense_sharded_sb/block",
-                 "multi-chip dense SmallBank: owner-routed shard_map step")
+                 "multi-chip dense SmallBank: owner-routed shard_map step",
+                 protocol=('certified', 'replicated'))
 def _t_dense_sharded_sb() -> TargetTrace:
     return _dense_sharded_sb("dense_sharded_sb/block")
 
 
 @register_target("dense_sharded_sb/block@mon",
                  "multi-chip dense SmallBank with per-device counter "
-                 "planes")
+                 "planes",
+                 protocol=('certified', 'replicated'))
 def _t_dense_sharded_sb_mon() -> TargetTrace:
     return _dense_sharded_sb("dense_sharded_sb/block@mon", monitor=True)
 
 
 # ----------------------------------------------------------------- API
 
-_trace_cache: dict[str, TargetTrace] = {}
+# trace-once cache shared by every pass in every analysis.run() of the
+# process (core.TraceCache records per-target build seconds for --time)
+TRACE_CACHE = TraceCache()
 
 
 def get_trace(name: str) -> TargetTrace:
-    """Build + trace a registered target (cached per process)."""
-    if name not in _trace_cache:
-        _trace_cache[name] = TARGETS[name]()
-    return _trace_cache[name]
+    """Build + trace a registered target (traced once per process; every
+    pass and every run() shares the cached jaxpr)."""
+    trace = TRACE_CACHE.get(name, TARGETS[name])
+    trace.protocol = TARGET_PROTOCOL.get(name, trace.protocol)
+    return trace

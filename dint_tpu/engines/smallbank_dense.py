@@ -302,6 +302,10 @@ def pipe_step(db: DenseBank, c1: BankCtx, key, *, w: int, n_accounts: int,
         bal_delta=bal_delta)
 
     # ---- wave 2 of c1: install + log x3 (locks expire by stamp) -----------
+    # MACHINE-CHECKED (dintlint protocol pass): c1.do_write descends from
+    # the S/X grants (lock-dominates-write), and the x_step/s_step writes
+    # stamp the step scalar — the expiring-lock witness that discharges
+    # abort-implies-unlock for this engine's release-free design.
     dwf = c1.do_write.reshape(-1)
     wrows = jnp.where(dwf, c1.rows.reshape(-1), oob)       # [wL]
     newbal = c1.nw.reshape(-1)

@@ -419,6 +419,10 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
     # COMMIT/INSERT/DELETE_PRIM and ABORT release the row lock in the
     # reference (shard_kern.c:338-476). Uniqueness: one X-holder per row,
     # and a txn's two slots target different tables.
+    # MACHINE-CHECKED (dintlint protocol pass, ANALYSIS.md): wmask must
+    # stay data-dependent on c2.alive — the chain grant -> alive ->
+    # ~changed -> wmask is what proves lock-dominates-write and
+    # validate-before-install; severing it fails the tier-1 gate.
     do_write = c2.ws_active & c2.alive[:, None]                 # [w, 2]
     wmask = do_write.reshape(-1)
     wkind = c2.ws_kind.reshape(-1)

@@ -185,7 +185,11 @@ def build_sharded_pipelined_runner(mesh: Mesh, n_shards: int,
         # carry types close under shard_map (identity on older jax)
         new_ctx, c1 = jax.tree.map(
             lambda x: pcast_varying(x, SHARD_AXIS), (new_ctx, c1))
-        # CommitBck + CommitLog fan-out: forward installs to d+1, d+2
+        # CommitBck + CommitLog fan-out: forward installs to d+1, d+2.
+        # MACHINE-CHECKED (dintlint protocol pass): the backup/log writes
+        # in _apply_backup must consume the PPERMUTED record (fwd), not
+        # the local one — commit-after-replication fails the gate if the
+        # hop's payload is dropped on the floor.
         for off in (1, 2):
             perm = [(i, (i + off) % n_shards) for i in range(n_shards)]
             fwd = jax.tree.map(functools.partial(

@@ -38,8 +38,9 @@ I32 = jnp.int32
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def run_pass(name, fn, args, mesh_axes=()):
-    tr = core.trace_target(f"fixture/{name}", fn, args, mesh_axes=mesh_axes)
+def run_pass(name, fn, args, mesh_axes=(), protocol=("certified",)):
+    tr = core.trace_target(f"fixture/{name}", fn, args, mesh_axes=mesh_axes,
+                           protocol=protocol)
     return analysis.PASSES[name](tr)
 
 
@@ -209,6 +210,237 @@ def test_shard_consistency_flags_bad_perms():
     assert not codes(run_pass("shard_consistency", sm(ok), arg), "error")
 
 
+# ---------------------------------------------------------------- protocol
+#
+# Mutated-engine fixtures for the dataflow pass: a miniature step-stamped
+# OCC engine under lax.scan (so facts must flow around the carry exactly
+# like the real pipelines' cohort contexts) with one protocol edge
+# deliberately severed per variant, and a mini explicit-release 2PL
+# engine plus a mini replicated shard step for the other two invariants.
+
+
+def _mini_occ_args():
+    W, N = 8, 32
+    return (S((N + 1,), U32), S((N + 1,), U32), S((N + 1,), U32), S((), U32),
+            S((W,), I32), S((W,), U32), S((W,), jnp.bool_), S((3, W), I32))
+
+
+def _mini_occ(variant: str):
+    """Step-stamped OCC mini engine: acquire (scatter-max of step<<K),
+    validate (meta re-read vs snapshot), install (mask descends from the
+    surviving-txn chain alive & ~changed, like the real pipelines).
+    `variant` severs one edge: "drop_lock" installs on validation alone,
+    "drop_validate" installs on the grant alone."""
+    W, N, KB = 8, 32, 8
+
+    def fn(tab, meta, arb, step, c_rows, c_snap, c_alive, xs_rows):
+        def body(carry, rows):
+            tab, meta, arb, step, c_rows, c_snap, c_alive = carry
+            # wave 3 of the in-flight cohort: validate then install
+            cur = meta[c_rows]
+            valid = cur == c_snap                      # VALIDATED seed
+            changed = (~valid)[:, None].any(axis=1)    # ABORT_MASK seed
+            if variant == "drop_lock":
+                mask = ~changed
+            elif variant == "drop_validate":
+                mask = c_alive
+            else:
+                mask = c_alive & ~changed
+            widx = jnp.where(mask, c_rows, N + 1)
+            meta2 = meta.at[widx].set(cur + U32(1), mode="drop",
+                                      unique_indices=True)
+            tab2 = tab.at[widx].set(c_rows.astype(U32), mode="drop",
+                                    unique_indices=True)
+            # wave 1 of a new cohort: expiring-stamp lock arbitration
+            lane = jnp.arange(W, dtype=U32)
+            packed = (step << U32(KB)) | (U32(W) - lane)
+            held = (arb[rows] >> U32(KB)) == step - U32(1)
+            cand = ~held
+            arb2 = arb.at[jnp.where(cand, rows, N + 1)].max(
+                packed, mode="drop")
+            grant = cand & (arb2[rows] == packed)      # LOCK_WIN seed
+            rejected = (~grant)[:, None].any(axis=1)   # ABORT_MASK seed
+            alive = grant & ~rejected
+            snap = meta2[rows]
+            carry = (tab2, meta2, arb2, step + U32(1), rows, snap, alive)
+            return carry, (changed | rejected).sum(dtype=jnp.int32)
+
+        carry = (tab, meta, arb, step, c_rows, c_snap, c_alive)
+        return jax.lax.scan(body, carry, xs_rows)
+
+    return fn
+
+
+def _mini_2pl(release: bool):
+    """Explicit-release mini 2PL engine: first-lane-wins arbitration over
+    a bool lock array (no step stamp — locks are sticky), validation,
+    install. ``release=True`` adds the release wave clearing EVERY
+    granted lock (committed or aborted); False models "return early past
+    the unlock wave": the only lock write left is the grant."""
+    W, N = 8, 32
+    BIG = jnp.int32(1 << 30)
+
+    def fn(tab, lock, c_rows, c_snap, c_grant, xs_rows):
+        def body(carry, rows):
+            tab, lock, c_rows, c_snap, c_grant = carry
+            cur = tab[c_rows]
+            valid = cur == c_snap                      # VALIDATED seed
+            changed = (~valid)[:, None].any(axis=1)    # ABORT_MASK seed
+            commit = c_grant & ~changed
+            widx = jnp.where(commit, c_rows, N + 1)
+            tab2 = tab.at[widx].set(cur + U32(1), mode="drop",
+                                    unique_indices=True)
+            lock2 = lock
+            if release:
+                # the release mask is `granted` — commits AND aborts —
+                # so it legitimately does NOT depend on the abort bit
+                ridx = jnp.where(c_grant, c_rows, N + 1)
+                lock2 = lock.at[ridx].set(False, mode="drop",
+                                          unique_indices=True)
+            # new cohort: first-lane-wins acquire on the lock array
+            lane = jnp.arange(W, dtype=I32)
+            first = jnp.full((N + 1,), BIG, I32).at[rows].min(
+                lane, mode="drop")
+            free = ~lock2[rows]
+            grant = free & (first[rows] == lane)       # LOCK_WIN seed
+            gidx = jnp.where(grant, rows, N + 1)
+            lock3 = lock2.at[gidx].set(True, mode="drop",
+                                       unique_indices=True)
+            snap = tab2[rows]
+            carry = (tab2, lock3, rows, snap, grant)
+            return carry, changed.sum(dtype=jnp.int32)
+
+        return jax.lax.scan(body, (tab, lock, c_rows, c_snap, c_grant),
+                            xs_rows)
+
+    return fn
+
+
+def _mini_repl(variant: str):
+    """Mini replicated shard step under shard_map: install locally, then
+    ("ok") ppermute the record to the +1 neighbor and apply it to the
+    backup slice; "no_push" installs without any collective; "drop_push"
+    ppermutes but applies the LOCAL record to the backup instead."""
+    mesh = _mesh4()
+    perm = [(i, (i + 1) % 4) for i in range(4)]
+
+    def body(bal, bck, rows, vals, mask):
+        bal, bck, rows, vals, mask = (x[0] for x in
+                                      (bal, bck, rows, vals, mask))
+        N = bal.shape[0] - 1
+        widx = jnp.where(mask, rows, N)
+        bal2 = bal.at[widx].set(vals, mode="drop", unique_indices=True)
+        if variant == "no_push":
+            f_rows, f_vals, f_mask = rows, vals, mask
+        else:
+            pp = functools.partial(jax.lax.ppermute, axis_name="shard",
+                                   perm=perm)
+            f_rows, f_vals, f_mask = pp(rows), pp(vals), pp(mask)
+            if variant == "drop_push":
+                f_rows, f_vals, f_mask = rows, vals, mask
+        bidx = jnp.where(f_mask, f_rows, N)
+        bck2 = bck.at[bidx].set(f_vals, mode="drop", unique_indices=True)
+        return bal2[None], bck2[None]
+
+    def fn(bal, bck, rows, vals, mask):
+        sm = jax.shard_map(body, mesh=mesh,
+                           in_specs=(P("shard"),) * 5,
+                           out_specs=(P("shard"),) * 2)
+        return sm(bal, bck, rows, vals, mask)
+
+    return fn
+
+
+def _repl_args():
+    return (S((4, 33), U32), S((4, 33), U32), S((4, 8), I32),
+            S((4, 8), U32), S((4, 8), jnp.bool_))
+
+
+@pytest.mark.parametrize("variant,code", [
+    ("drop_lock", "unlocked-install"),
+    ("drop_validate", "unvalidated-install"),
+])
+@pytest.mark.lint
+def test_protocol_occ_fixtures_fire(variant, code):
+    fs = run_pass("protocol", _mini_occ(variant), _mini_occ_args(),
+                  protocol=("certified", "occ"))
+    assert code in codes(fs, "error"), [str(f) for f in fs]
+    # each severed edge trips exactly its own invariant, not its sibling
+    other = ({"unlocked-install", "unvalidated-install"} - {code}).pop()
+    assert other not in codes(fs, "error")
+
+
+@pytest.mark.lint
+def test_protocol_safe_occ_engine_clean():
+    fs = run_pass("protocol", _mini_occ("safe"), _mini_occ_args(),
+                  protocol=("certified", "occ"))
+    assert not codes(fs, "error"), [str(f) for f in fs]
+
+
+@pytest.mark.lint
+def test_protocol_abort_unlock_fixture():
+    args = (S((33,), U32), S((33,), jnp.bool_), S((8,), I32), S((8,), U32),
+            S((8,), jnp.bool_), S((3, 8), I32))
+    broken = run_pass("protocol", _mini_2pl(release=False), args)
+    assert "abort-leaks-lock" in codes(broken, "error"), \
+        [str(f) for f in broken]
+    safe = run_pass("protocol", _mini_2pl(release=True), args)
+    assert "abort-leaks-lock" not in codes(safe, "error"), \
+        [str(f) for f in safe]
+
+
+@pytest.mark.parametrize("variant,code", [
+    ("no_push", "no-replication-push"),
+    ("drop_push", "push-not-applied"),
+])
+@pytest.mark.lint
+def test_protocol_replication_fixtures_fire(variant, code):
+    fs = run_pass("protocol", _mini_repl(variant), _repl_args(),
+                  protocol=("replicated",))
+    assert code in codes(fs, "error"), [str(f) for f in fs]
+
+
+@pytest.mark.lint
+def test_protocol_safe_replication_clean():
+    fs = run_pass("protocol", _mini_repl("ok"), _repl_args(),
+                  protocol=("replicated",))
+    assert not codes(fs, "error"), [str(f) for f in fs]
+
+
+@pytest.mark.lint
+@pytest.mark.parametrize("target", [
+    "tatp_dense/block",            # dense OCC, XLA route
+    "tatp_dense/block@pallas",     # grant comes from the fused kernel
+    "tatp_pipeline/block",         # generic sort-based OCC
+    "smallbank_dense/block",       # 2PL expiring stamps
+    "dense_sharded/block",         # OCC + ICI replication
+])
+def test_protocol_clean_on_real_engines(target):
+    """Safe-idiom controls: the dense, pipeline, and pallas variants of
+    the real engines satisfy every protocol check through genuine
+    dataflow (no allowlist involved)."""
+    fs = analysis.run(targets=[target], passes=["protocol"])
+    assert not [str(f) for f in fs if f.severity == "error"]
+
+
+@pytest.mark.lint
+def test_protocol_dense_installs_prove_lock_and_validate():
+    """The interprocedural claim itself: the flagship engine's install
+    scatters carry LOCK_WIN *and* VALIDATED — facts seeded at the grant
+    compare / validate compare and flowed around two scan-carry hops —
+    without leaning on the segment-sort evidence ladder."""
+    from dint_tpu.analysis import dataflow as df
+    trace = analysis.get_trace("tatp_dense/block")
+    flow = df.analyze(trace)
+    installs = [r for r in flow.scatters
+                if r.prim == "scatter" and r.is_state and not r.in_pallas]
+    assert installs
+    for r in installs:
+        assert df.LOCK_WIN in r.write_facts, r.site
+        assert df.VALIDATED in r.write_facts, r.site
+        assert df.SORTED not in r.write_facts, r.site
+
+
 # --------------------------------------------------------------- allowlist
 
 
@@ -282,6 +514,9 @@ def _broken_findings(pname):
         sm = jax.shard_map(body, mesh=_mesh4(), in_specs=P("shard"),
                            out_specs=P("shard"))
         return run_pass("shard_consistency", sm, (S((8, 4), jnp.float32),))
+    if pname == "protocol":
+        return run_pass("protocol", _mini_occ("drop_lock"),
+                        _mini_occ_args(), protocol=("certified", "occ"))
     raise AssertionError(pname)
 
 
@@ -322,12 +557,57 @@ def test_dintlint_gate_all_targets():
 def test_cli_json_single_target():
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "dintlint.py"),
-         "--target", "tatp_dense/block", "--json"],
+         "--target", "tatp_dense/block", "--json", "--time"],
         capture_output=True, text=True, cwd=REPO, timeout=300)
     assert out.returncode == 0, out.stderr[-2000:]
     payload = json.loads(out.stdout.strip().splitlines()[-1])
     assert payload["metric"] == "dintlint" and payload["ok"] is True
-    # schema-stable keys downstream parsing relies on
-    for k in ("targets", "passes", "n_findings", "n_errors",
+    # schema-stable keys downstream parsing (bench artifacts) relies on
+    for k in ("schema", "targets", "passes", "n_findings", "n_errors",
               "n_suppressed", "findings"):
         assert k in payload
+    assert isinstance(payload["schema"], int) and payload["schema"] >= 2
+    # --time: per-target trace/pass wall time rides the payload
+    t = payload["timing"]["targets"]["tatp_dense/block"]
+    assert "trace_s" in t and "protocol" in t["passes"]
+
+
+@pytest.mark.lint
+def test_cli_unknown_names_exit_2_with_registry():
+    """Typos exit 2 with the registered names, never a traceback."""
+    for args in (["--target", "nope/bad"], ["--all", "--pass", "nope"]):
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "dintlint.py"),
+             *args],
+            capture_output=True, text=True, cwd=REPO, timeout=300)
+        assert out.returncode == 2, (out.returncode, out.stderr[-500:])
+        assert "Traceback" not in out.stderr
+        assert "unknown" in out.stderr and "registered" in out.stderr
+        assert "tatp_dense/block" in out.stderr or "protocol" \
+            in out.stderr
+
+
+# ---------------------------------------------------------- prune helpers
+
+
+def test_allowlist_prune_drops_only_stale_entries(tmp_path):
+    """--prune-allowlist semantics at the library level: after apply()
+    over findings, prune_entries splits used from stale and save()
+    rewrites the file without private bookkeeping keys."""
+    path = tmp_path / "allow.json"
+    path.write_text(json.dumps([
+        {"pass": "scatter_race", "code": "nonunique-scatter",
+         "target": "fixture/scatter_race", "reason": "live entry"},
+        {"pass": "scatter_race", "code": "no-such-code",
+         "reason": "stale entry"}]))
+    entries = al.load(str(path))
+    al.apply(_broken_scatter_findings(), entries)
+    kept, dropped = al.prune_entries(entries)
+    assert [e["code"] for e in kept] == ["nonunique-scatter"]
+    assert [e["code"] for e in dropped] == ["no-such-code"]
+    al.save(str(path), kept)
+    rewritten = json.loads(path.read_text())
+    assert rewritten == [{"pass": "scatter_race",
+                          "code": "nonunique-scatter",
+                          "target": "fixture/scatter_race",
+                          "reason": "live entry"}]   # `_used` stripped

@@ -1,22 +1,29 @@
 """dintlint CLI: static analysis gate over every registered hot path.
 
 Runs the dint_tpu/analysis pass suite (scatter races, buffer aliasing,
-hot-path purity, u64 stamp overflow, shard_map consistency — ANALYSIS.md)
-over the registered engine/sharded step functions, traced with abstract
-values on CPU: no TPU, no tunnel window, CI-speed.
+hot-path purity, u64 stamp overflow, shard_map consistency, and the
+dintproof protocol dataflow checks — ANALYSIS.md) over the registered
+engine/sharded step functions, traced with abstract values on CPU: no
+TPU, no tunnel window, CI-speed. Each target is traced ONCE per process
+and the jaxpr is shared by every pass (analysis/core.TraceCache).
 
 Usage:
     python tools/dintlint.py --all                    # everything
     python tools/dintlint.py --target tatp_dense/block --target sharded/tatp
-    python tools/dintlint.py --all --pass scatter_race --pass aliasing
+    python tools/dintlint.py --all --pass scatter_race --pass protocol
     python tools/dintlint.py --all --json             # one JSON line
+    python tools/dintlint.py --all --time             # wall-time report
     python tools/dintlint.py --all --allowlist tools/dintlint_allow.json
+    python tools/dintlint.py --prune-allowlist        # drop stale entries
     python tools/dintlint.py --list                   # targets + passes
 
 Exit code: 0 when no unsuppressed error-severity finding remains (warnings
-and info never fail the gate), 1 otherwise, 2 on usage errors. The default
-allowlist is tools/dintlint_allow.json when it exists; every suppression
-needs a written reason and stays visible in the report (analysis/allowlist).
+and info never fail the gate), 1 otherwise, 2 on usage errors — an unknown
+--target/--pass prints the registered names and exits 2, never a
+traceback. The default allowlist is tools/dintlint_allow.json when it
+exists; every suppression needs a written reason and stays visible in the
+report (analysis/allowlist). `--prune-allowlist` runs the FULL matrix and
+rewrites the file dropping entries that no longer match any finding.
 """
 from __future__ import annotations
 
@@ -40,9 +47,44 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 from dint_tpu import analysis  # noqa: E402
+from dint_tpu.analysis import allowlist as al  # noqa: E402
 
 DEFAULT_ALLOWLIST = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                  "dintlint_allow.json")
+
+# bumped when keys of the --json payload change shape; bench artifacts
+# embed the payload and validate against this
+JSON_SCHEMA = 2
+
+
+def _check_names(kind, names, registry):
+    """Unknown --target/--pass = usage error (exit 2) listing what IS
+    registered, never a traceback."""
+    bad = [n for n in names if n not in registry]
+    if not bad:
+        return None
+    lines = [f"unknown {kind} {n!r}" for n in bad]
+    lines.append(f"registered {kind}s:")
+    lines += [f"  {n}" for n in sorted(registry)]
+    return "\n".join(lines)
+
+
+def _print_timing(timings: dict):
+    per_target = timings.get("targets", {})
+    pass_totals: dict[str, float] = {}
+    print(f"{'target':34s} {'trace_s':>8s} {'passes_s':>9s}")
+    for name, t in per_target.items():
+        passes_s = sum(t["passes"].values())
+        for p, s in t["passes"].items():
+            pass_totals[p] = pass_totals.get(p, 0.0) + s
+        cached = " (cached)" if t["cached"] else ""
+        print(f"{name:34s} {t['trace_s']:8.2f} {passes_s:9.3f}{cached}")
+    print("per-pass totals:")
+    for p, s in sorted(pass_totals.items()):
+        print(f"  {p:32s} {s:8.3f}s")
+    print(f"matrix total: {timings.get('total_s', 0.0):.2f}s "
+          f"(trace {sum(t['trace_s'] for t in per_target.values()):.2f}s"
+          f" + passes {sum(pass_totals.values()):.2f}s)", flush=True)
 
 
 def main(argv=None) -> int:
@@ -57,9 +99,15 @@ def main(argv=None) -> int:
                     help="pass name (repeatable); default: all passes")
     ap.add_argument("--json", action="store_true",
                     help="emit one machine-parseable JSON line")
+    ap.add_argument("--time", action="store_true",
+                    help="report per-target/per-pass wall time (and embed "
+                         "it under 'timing' with --json)")
     ap.add_argument("--allowlist", default=None,
                     help="allowlist JSON path (default: "
                          "tools/dintlint_allow.json when present)")
+    ap.add_argument("--prune-allowlist", action="store_true",
+                    help="run the FULL matrix, then rewrite the allowlist "
+                         "dropping entries that matched no finding")
     ap.add_argument("--list", action="store_true",
                     help="list registered targets and passes, then exit")
     args = ap.parse_args(argv)
@@ -67,32 +115,70 @@ def main(argv=None) -> int:
     if args.list:
         print("targets:")
         for name, doc in analysis.TARGET_DOCS.items():
-            print(f"  {name:32s} {doc}")
+            proto = ",".join(analysis.TARGET_PROTOCOL.get(name, ()))
+            print(f"  {name:32s} [{proto}] {doc}")
         print("passes:")
         for name, doc in analysis.PASS_DOCS.items():
             print(f"  {name:32s} {doc}")
         return 0
 
-    if not args.all and not args.target:
+    if args.prune_allowlist and (args.target or args.passes):
+        ap.error("--prune-allowlist needs the full matrix: stale-entry "
+                 "detection over a subset run would drop entries whose "
+                 "findings simply were not traced (drop --target/--pass)")
+    if not args.all and not args.target and not args.prune_allowlist:
         ap.error("pick targets with --target/--all (or --list to see them)")
+
+    err = (_check_names("target", args.target, analysis.TARGETS)
+           or _check_names("pass", args.passes, analysis.PASSES))
+    if err:
+        ap.error(err)
 
     allowlist = args.allowlist
     if allowlist is None and os.path.exists(DEFAULT_ALLOWLIST):
         allowlist = DEFAULT_ALLOWLIST
 
-    try:
-        findings = analysis.run(
-            targets=None if args.all else args.target,
-            passes=args.passes or None,
-            allowlist_path=allowlist)
-    except KeyError as e:
-        ap.error(str(e))
+    timings: dict = {}
+    if args.prune_allowlist:
+        if not allowlist or not os.path.exists(allowlist):
+            ap.error("--prune-allowlist: no allowlist file found "
+                     f"(looked for {allowlist or DEFAULT_ALLOWLIST})")
+        entries = al.load(allowlist)
+        findings = analysis.run(allowlist_entries=entries, timings=timings)
+        kept, dropped = al.prune_entries(entries)
+        if dropped:
+            al.save(allowlist, kept)
+            print(f"pruned {len(dropped)} stale entr"
+                  f"{'y' if len(dropped) == 1 else 'ies'} from "
+                  f"{allowlist} ({len(kept)} kept):")
+            for e in dropped:
+                print(f"  - {e['pass']}/{e['code']} "
+                      f"(target={e.get('target', '*')})")
+        else:
+            print(f"{allowlist}: all {len(kept)} entries still match — "
+                  "nothing to prune")
+        # the rewritten file is now exactly the used set: drop the
+        # unused-entry hygiene warnings from the report below
+        findings = [f for f in findings
+                    if not (f.pass_name == "allowlist"
+                            and f.code == "unused-entry")]
+    else:
+        try:
+            findings = analysis.run(
+                targets=None if args.all else args.target,
+                passes=args.passes or None,
+                allowlist_path=allowlist,
+                timings=timings)
+        except KeyError as e:       # defense in depth; names pre-checked
+            ap.error(str(e))
 
     failed = analysis.has_errors(findings)
     if args.json:
-        print(json.dumps({
+        payload = {
             "metric": "dintlint",
-            "targets": (sorted(analysis.TARGETS) if args.all
+            "schema": JSON_SCHEMA,
+            "targets": (sorted(analysis.TARGETS)
+                        if args.all or args.prune_allowlist
                         else args.target),
             "passes": args.passes or sorted(analysis.PASSES),
             "allowlist": allowlist,
@@ -102,13 +188,18 @@ def main(argv=None) -> int:
             "n_suppressed": sum(f.suppressed for f in findings),
             "ok": not failed,
             "findings": [f.to_dict() for f in findings],
-        }), flush=True)
+        }
+        if args.time:
+            payload["timing"] = timings
+        print(json.dumps(payload), flush=True)
     else:
         for f in findings:
             print(f)
         n_err = sum(f.severity == "error" and not f.suppressed
                     for f in findings)
         n_sup = sum(f.suppressed for f in findings)
+        if args.time:
+            _print_timing(timings)
         print(f"dintlint: {len(findings)} finding(s), {n_err} error(s), "
               f"{n_sup} suppressed -> {'FAIL' if failed else 'ok'}",
               flush=True)
