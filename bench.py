@@ -55,6 +55,13 @@ WIDTH = int(os.environ.get("DINT_BENCH_WIDTH", 8192))   # txns per cohort
 BLOCK = int(os.environ.get("DINT_BENCH_BLOCK", 16))     # cohorts per dispatch
 VAL_WORDS = 10
 WINDOW_S = float(os.environ.get("DINT_BENCH_WINDOW_S", 10.0))
+# SmallBank skew knobs (the --hot-frac/--hot-prob of the sweep drivers,
+# env-style like every bench knob): None = the reference 90%/4% skew. The
+# dintcache hot tier (DINT_USE_HOTSET=1) aligns its mirror to HOT_FRAC.
+HOT_FRAC = (float(os.environ["DINT_BENCH_HOT_FRAC"])
+            if "DINT_BENCH_HOT_FRAC" in os.environ else None)
+HOT_PROB = (float(os.environ["DINT_BENCH_HOT_PROB"])
+            if "DINT_BENCH_HOT_PROB" in os.environ else None)
 
 # Patience budget (round-4 postmortem: the old schedule's ~39-min worst
 # case exceeded the driver's timeout, so the stale fallback that ran only
@@ -285,6 +292,12 @@ def _child_main():
         # which random-access backend actually ran (pallas may have been
         # requested and degraded) — A/B artifacts must be distinguishable
         "use_pallas": bool(use_pallas),
+        # dintcache hot tier + skew provenance (TATP itself keeps the hot
+        # tier off — uniform NURand; the flag records the env so the
+        # SmallBank leg's A/B state is readable from the headline line)
+        "use_hotset": pg.env_use_hotset(),
+        "hot_frac": HOT_FRAC,
+        "hot_prob": HOT_PROB,
         # end-of-run dintmon snapshot, schema-stable: a {name: count}
         # object when DINT_MONITOR=1, EXPLICIT null otherwise — consumers
         # never need to distinguish "off" from "old artifact schema"
@@ -384,7 +397,9 @@ def _bench_smallbank():
         n_accounts=int(os.environ.get("DINT_BENCH_SB_ACCOUNTS",
                                       bench_smallbank.N_ACCOUNTS)),
         widths=widths,
-        block=BLOCK)
+        block=BLOCK,
+        hot_frac=HOT_FRAC,
+        hot_prob=HOT_PROB)
 
 
 ARTIFACT_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),

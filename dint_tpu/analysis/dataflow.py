@@ -72,7 +72,7 @@ collectives) are recorded only on phase 2's final converged pass.
 The result (`Dataflow`) is an inventory the protocol pass consumes:
 per-scatter fact summaries with operand roots (which persistent array a
 scatter chain writes), seed sites, ppermute sites, and detected Pallas
-lock kernels. `analyze()` memoizes per TargetTrace, so the 19-target
+lock kernels. `analyze()` memoizes per TargetTrace, so the full target
 matrix pays one dataflow per trace however many checks read it.
 """
 from __future__ import annotations
@@ -435,6 +435,15 @@ class _Analyzer:
                 merged.add(TBL_READ)
             if is_lock:
                 merged.add(ARB)
+            elif aliases:
+                # an aliased NON-lock kernel is an in-place overwrite
+                # install (ops/pallas_gather.scatter_rows_hot): it kills
+                # the arb character of the buffer exactly like an XLA
+                # overwrite scatter — otherwise ARB picked up from a
+                # grant-derived mask would ride the installed table
+                # around the carry and turn the next validate compare
+                # into a spurious LOCK_WIN seed
+                merged.discard(ARB)
         else:
             if is_lock:
                 merged.add(LOCK_WIN)

@@ -160,16 +160,18 @@ def _t_tatp_dense_drain() -> TargetTrace:
 # ------------------------------------------------------- dense SmallBank
 
 
-def _sb_dense(name: str, use_pallas: bool,
-              monitor: bool = False) -> TargetTrace:
+def _sb_dense(name: str, use_pallas: bool, monitor: bool = False,
+              use_hotset: bool = False) -> TargetTrace:
     from ..engines import smallbank_dense as sd
-    from .. import monitor as mn
-    run = sd.build_pipelined_runner(_N_ACCT, w=_W, cohorts_per_block=_BLK,
-                                    use_pallas=use_pallas,
-                                    monitor=monitor)[0]
-    carry = _abstract(lambda: (sd.create(_N_ACCT, log_capacity=_LOGCAP),
-                               sd.empty_ctx(_W))
-                      + ((mn.create(),) if monitor else ()))
+    run, init, _ = sd.build_pipelined_runner(_N_ACCT, w=_W,
+                                             cohorts_per_block=_BLK,
+                                             use_pallas=use_pallas,
+                                             use_hotset=use_hotset,
+                                             monitor=monitor)
+    # carry via the runner's own init so the @hot variants get the hot
+    # mirror attached exactly as production does
+    carry = _abstract(lambda: init(sd.create(_N_ACCT,
+                                             log_capacity=_LOGCAP)))
     return trace_target(name, run, (carry, _key_aval()))
 
 
@@ -193,6 +195,35 @@ def _t_sb_dense_pl() -> TargetTrace:
 def _t_sb_dense_mon() -> TargetTrace:
     return _sb_dense("smallbank_dense/block@mon", use_pallas=False,
                      monitor=True)
+
+
+@register_target("smallbank_dense/block@hot",
+                 "dense SmallBank with the dintcache hot-set partition "
+                 "(XLA index-compare route): lock-dominates-write proven "
+                 "through the partitioned write-through install",
+                 protocol=('certified',))
+def _t_sb_dense_hot() -> TargetTrace:
+    return _sb_dense("smallbank_dense/block@hot", use_pallas=False,
+                     use_hotset=True)
+
+
+@register_target("smallbank_dense/block@hot+pallas",
+                 "dense SmallBank: hot-set partition served by the VMEM "
+                 "kernels (gather_rows_hot + fused scatter_rows_hot, "
+                 "double-donated aliasing)",
+                 protocol=('certified',))
+def _t_sb_dense_hot_pl() -> TargetTrace:
+    return _sb_dense("smallbank_dense/block@hot+pallas", use_pallas=True,
+                     use_hotset=True)
+
+
+@register_target("smallbank_dense/block@hot+mon",
+                 "dense SmallBank: hot-set partition + counter plane "
+                 "(hot_hits/hot_cold_rows/hot_refresh_bytes scatter-adds)",
+                 protocol=('certified',))
+def _t_sb_dense_hot_mon() -> TargetTrace:
+    return _sb_dense("smallbank_dense/block@hot+mon", use_pallas=False,
+                     use_hotset=True, monitor=True)
 
 
 # ---------------------------------------------------- generic pipelines
@@ -339,12 +370,14 @@ def _t_dense_sharded_mon() -> TargetTrace:
                           monitor=True)
 
 
-def _dense_sharded_sb(name: str, monitor: bool = False) -> TargetTrace:
+def _dense_sharded_sb(name: str, monitor: bool = False,
+                      use_hotset: bool = False) -> TargetTrace:
     from ..parallel import dense_sharded_sb as dsb
     mesh = _mesh(_MESH_SHARDS)
     run, init, _ = dsb.build_sharded_sb_runner(
         mesh, _MESH_SHARDS, _N_ACCT * _MESH_SHARDS, w=_W,
-        cohorts_per_block=_BLK, use_pallas=False, monitor=monitor)
+        cohorts_per_block=_BLK, use_pallas=False, use_hotset=use_hotset,
+        monitor=monitor)
     carry = _abstract(lambda: init(dsb.create_sharded_sb(
         mesh, _MESH_SHARDS, _N_ACCT * _MESH_SHARDS)))
     return trace_target(name, run, (carry, _key_aval()),
@@ -364,6 +397,51 @@ def _t_dense_sharded_sb() -> TargetTrace:
                  protocol=('certified', 'replicated'))
 def _t_dense_sharded_sb_mon() -> TargetTrace:
     return _dense_sharded_sb("dense_sharded_sb/block@mon", monitor=True)
+
+
+@register_target("dense_sharded_sb/block@hot",
+                 "multi-chip dense SmallBank with per-device dintcache "
+                 "mirrors: certification + replication proven through "
+                 "the partitioned owner-side install",
+                 protocol=('certified', 'replicated'))
+def _t_dense_sharded_sb_hot() -> TargetTrace:
+    return _dense_sharded_sb("dense_sharded_sb/block@hot",
+                             use_hotset=True)
+
+
+# ------------------------------------------------------ hot-set TATP
+
+
+@register_target("tatp_dense/block@hot",
+                 "dense TATP with the dintcache row-prefix partition "
+                 "(skewed-TATP experiments; OCC chain proven through the "
+                 "partitioned meta/val write-through installs)",
+                 protocol=('certified', 'occ'))
+def _t_tatp_dense_hot() -> TargetTrace:
+    from ..engines import tatp_dense as td
+    run, init, _ = td.build_pipelined_runner(_N_SUB, w=_W, val_words=_VW,
+                                             cohorts_per_block=_BLK,
+                                             use_pallas=False,
+                                             use_hotset=True)
+    carry = _abstract(lambda: init(td.create(_N_SUB, val_words=_VW,
+                                             log_capacity=_LOGCAP)))
+    return trace_target("tatp_dense/block@hot", run, (carry, _key_aval()))
+
+
+@register_target("tatp_dense/block@hot+pallas",
+                 "dense TATP: row-prefix partition + VMEM kernels incl. "
+                 "the hot-prefix lock_arbitrate residency",
+                 protocol=('certified', 'occ'))
+def _t_tatp_dense_hot_pl() -> TargetTrace:
+    from ..engines import tatp_dense as td
+    run, init, _ = td.build_pipelined_runner(_N_SUB, w=_W, val_words=_VW,
+                                             cohorts_per_block=_BLK,
+                                             use_pallas=True,
+                                             use_hotset=True)
+    carry = _abstract(lambda: init(td.create(_N_SUB, val_words=_VW,
+                                             log_capacity=_LOGCAP)))
+    return trace_target("tatp_dense/block@hot+pallas", run,
+                        (carry, _key_aval()))
 
 
 # ----------------------------------------------------------------- API

@@ -33,26 +33,40 @@ WIDTHS = (8192, 16384)
 
 
 def run(window_s: float = 10.0, n_accounts: int = N_ACCOUNTS,
-        widths=WIDTHS, block: int = BLOCK) -> dict:
+        widths=WIDTHS, block: int = BLOCK, hot_frac: float | None = None,
+        hot_prob: float | None = None) -> dict:
     """Bench every width in ``widths``; headline the abort-matched point
-    and quote all (width, tps, abort_rate) points."""
-    points = [_run_one(window_s, n_accounts, w, block) for w in widths]
+    and quote all (width, tps, abort_rate) points.
+
+    ``hot_frac``/``hot_prob`` override the workload's 90%/4% skew (the
+    bench.py --hot-frac/--hot-prob knobs); the dintcache hot tier follows
+    DINT_USE_HOTSET (the builder aligns its mirror to hot_frac)."""
+    points = [_run_one(window_s, n_accounts, w, block, hot_frac, hot_prob)
+              for w in widths]
     head = min(points, key=lambda p: p["abort_rate"])
     return {
         "smallbank_committed_txns_per_sec": head["committed_tps"],
         "smallbank_abort_rate": head["abort_rate"],
         "smallbank_width": head["width"],
         "smallbank_points": points,
+        "smallbank_use_hotset": head["use_hotset"],
+        "smallbank_hot_frac": head["hot_frac"],
+        "smallbank_hot_prob": head["hot_prob"],
         "smallbank_balance_conserved": True,
     }
 
 
-def _run_one(window_s: float, n_accounts: int, width: int,
-             block: int) -> dict:
+def _run_one(window_s: float, n_accounts: int, width: int, block: int,
+             hot_frac: float | None = None,
+             hot_prob: float | None = None) -> dict:
+    from ..ops import pallas_gather as pg
+    from . import workloads as wl
+
     db = sd.create(n_accounts)
     base = int(np.asarray(sd.total_balance(db)))
     runner, init, drain = sd.build_pipelined_runner(
-        n_accounts, w=width, cohorts_per_block=block)
+        n_accounts, w=width, cohorts_per_block=block, hot_frac=hot_frac,
+        hot_prob=hot_prob)
     carry = init(db)
     key = jax.random.PRNGKey(1)
 
@@ -87,4 +101,9 @@ def _run_one(window_s: float, n_accounts: int, width: int,
         "width": width,
         "committed_tps": round(committed / dt, 1),
         "abort_rate": round(1 - committed / max(attempted, 1), 5),
+        # skew + hot-tier provenance: A/B artifacts must be
+        # distinguishable (same rule as bench.py's "use_pallas")
+        "use_hotset": pg.resolve_use_hotset(None),
+        "hot_frac": wl.SB_HOT_FRAC if hot_frac is None else float(hot_frac),
+        "hot_prob": wl.SB_HOT_PROB if hot_prob is None else float(hot_prob),
     }

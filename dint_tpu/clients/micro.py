@@ -80,13 +80,49 @@ class _SteppedClient:
 class StoreClient(_SteppedClient):
     """GET/SET mix over a pre-populated table. ``read_frac=1.0`` is the
     reference's 'parallel' benchmark, 0.5 the 'contention' one
-    (store/caladan/client_caladan.cc:56-66)."""
+    (store/caladan/client_caladan.cc:56-66).
+
+    ``key_dist="zipfian"`` draws keys from the YCSB-style Zipfian whose
+    hot head is the smallest key ids (workloads.zipf_keys) — DINT's
+    skewed store benchmark. ``use_hotset`` (None = DINT_USE_HOTSET env)
+    attaches the dintcache mirror for the first ``hot_frac`` of the
+    keyspace and threads it through every step (write-through,
+    bit-identical replies); DINT_USE_PALLAS additionally serves the
+    partition with the VMEM hot kernels."""
 
     def __init__(self, table: kv.KVTable, n_keys: int, width: int = 4096,
-                 val_words: int = 10, read_frac: float = 0.5):
-        super().__init__(table, store.step, width, val_words)
+                 val_words: int = 10, read_frac: float = 0.5,
+                 key_dist: str = "uniform", zipf_theta: float = wl.ZIPF_THETA,
+                 hot_frac: float | None = None, use_hotset=None,
+                 use_pallas=None):
+        from ..ops import pallas_gather as pg
+
+        assert key_dist in ("uniform", "zipfian")
+        self.use_hotset = pg.resolve_use_hotset(use_hotset)
+        up = pg.resolve_use_pallas(use_pallas, n_idx=width, m_lock=None)
+        if self.use_hotset:
+            if up and not pg.hot_kernels_available(n_idx=width):
+                up = False
+            frac = 0.04 if hot_frac is None else float(hot_frac)
+            # mirror ids are key_lo < hot_n; keys are 1-based, so cover
+            # keys 1..frac*n with hot_n = frac*n + 1
+            hot_n = min(int(n_keys * frac) + 1, n_keys + 1)
+            hot = store.attach_hot(table, hot_n)
+
+            def step_fn(state, batch, _up=up):
+                t, h = state
+                t, rep, h = store.step(t, batch, hot=h, use_pallas=_up)
+                return (t, h), rep
+
+            state = (table, hot)
+        else:
+            state, step_fn = table, store.step
+        super().__init__(state, step_fn, width, val_words)
         self.n_keys = n_keys
         self.read_frac = read_frac
+        self.key_dist = key_dist
+        self.zipf_theta = zipf_theta
+        self.use_pallas = up
 
     @classmethod
     def populated(cls, n_keys: int, *, n_buckets: int | None = None,
@@ -95,9 +131,14 @@ class StoreClient(_SteppedClient):
                                  val_words=val_words)
         return cls(table, n_keys, val_words=val_words, **kw)
 
+    def _keys(self, rng: np.random.Generator, n: int) -> np.ndarray:
+        if self.key_dist == "zipfian":
+            return wl.zipf_keys(rng, n, self.n_keys, self.zipf_theta)
+        return rng.integers(1, self.n_keys + 1, size=n).astype(np.uint64)
+
     def run_wave(self, rng: np.random.Generator, n: int | None = None):
         n = n or self.width
-        keys = rng.integers(1, self.n_keys + 1, size=n).astype(np.uint64)
+        keys = self._keys(rng, n)
         is_read = rng.random(n) < self.read_frac
         ops = np.where(is_read, Op.GET, Op.SET).astype(np.int32)
         vals = np.zeros((n, self.vw), np.uint32)

@@ -51,6 +51,38 @@ def sb_make_txns(rng: np.random.Generator, n: int, n_accounts: int,
     return ttype, a1, a2
 
 
+# ----------------------------------------------------------------- zipf
+
+ZIPF_THETA = 0.99          # YCSB default skew; DINT's store micro is Zipfian
+
+_zipf_cdf_cache: dict[tuple[int, float], np.ndarray] = {}
+
+
+def zipf_cdf(n_keys: int, theta: float = ZIPF_THETA) -> np.ndarray:
+    """CDF of the Zipfian rank distribution P(k) ∝ 1/k^theta over ranks
+    [1, n_keys], cached per (n_keys, theta) — one float64 cumsum, reused
+    by every wave of a client."""
+    key = (int(n_keys), float(theta))
+    cdf = _zipf_cdf_cache.get(key)
+    if cdf is None:
+        w = 1.0 / np.power(np.arange(1, n_keys + 1, dtype=np.float64),
+                           theta)
+        cdf = np.cumsum(w / w.sum())
+        _zipf_cdf_cache[key] = cdf
+    return cdf
+
+
+def zipf_keys(rng: np.random.Generator, n: int, n_keys: int,
+              theta: float = ZIPF_THETA) -> np.ndarray:
+    """Zipfian key ids in [1, n_keys] with rank == key id (no scramble):
+    the hot head IS the smallest ids, i.e. the dintcache hot-set prefix —
+    the same alignment the reference's skewed store benchmark exploits
+    with its in-kernel cache (DINT NSDI'24 §store)."""
+    u = rng.random(n)
+    k = np.searchsorted(zipf_cdf(n_keys, theta), u, side="right") + 1
+    return np.clip(k, 1, n_keys).astype(np.uint64)
+
+
 # ---------------------------------------------------------------- tatp
 
 TATP_GET_SUBSCRIBER = 0

@@ -107,13 +107,27 @@ def lock_slots_for(m1: int) -> int:
 @flax.struct.dataclass
 class DenseBank:
     """Both tables + locks + logs in flat dense arrays (row M = 2N is the
-    gather sentinel; masked scatters route out of bounds and drop)."""
+    gather sentinel; masked scatters route out of bounds and drop).
+
+    The ``hot_*`` leaves are the dintcache hot tier (round 10): a compact
+    physical mirror of the hot-account prefix — mirror index
+    ``tbl * hot_n + acc`` for accounts ``acc < hot_n`` — that every
+    install writes through to, so mirror == table prefix is an invariant,
+    not a protocol. ``hot_x``/``hot_s`` exist only while the lock table
+    is EXACT (slot == row): under the hashed slot cap a cold account can
+    conflate onto a hot account's slot, which would make a slot mirror
+    incoherent, so hashed geometries serve stamps from the full arrays.
+    None (the default) = no hot tier; the pytree and jaxpr are unchanged."""
     bal: jax.Array       # u32 [M+1]  balances (i32 bits)
     x_step: jax.Array    # u32 [H]    last step an X grant stamped the slot
     s_step: jax.Array    # u32 [H]    last step an S grant stamped the slot
     step: jax.Array      # u32 scalar, monotonic (starts at 2: stamp 0 is
                          #   "never held", so step-1 must never be 0)
     log: logring.RepLog  # 3 replica entries packed per slot (log x3)
+    hot_bal: jax.Array | None = None   # u32 [2*hot_n] balance mirror
+    hot_x: jax.Array | None = None     # u32 [2*hot_n] X-stamp mirror (exact)
+    hot_s: jax.Array | None = None     # u32 [2*hot_n] S-stamp mirror (exact)
+    hot_n: int = flax.struct.field(pytree_node=False, default=0)
 
     @property
     def n_accounts(self):
@@ -122,6 +136,23 @@ class DenseBank:
     @property
     def lock_slots(self):
         return self.x_step.shape[0]
+
+
+def attach_hotset(db: DenseBank, hot_n: int) -> DenseBank:
+    """Build the hot mirror for accounts [0, hot_n) from the current
+    tables (a few MiB at the bench's 960k-account hot set). Stamps are
+    mirrored only in the exact lock regime — see DenseBank."""
+    n = db.n_accounts
+    hot_n = int(min(max(int(hot_n), 1), n))
+    m1 = 2 * n + 1
+    idx = jnp.concatenate([jnp.arange(hot_n, dtype=I32),
+                           n + jnp.arange(hot_n, dtype=I32)])
+    exact = db.lock_slots >= m1
+    return db.replace(
+        hot_bal=db.bal[idx],
+        hot_x=db.x_step[idx] if exact else None,
+        hot_s=db.s_step[idx] if exact else None,
+        hot_n=hot_n)
 
 
 def create(n_accounts: int, init_balance: int = 1000, log_lanes: int = 16,
@@ -203,7 +234,7 @@ def _stats_of(c: BankCtx):
 
 def pipe_step(db: DenseBank, c1: BankCtx, key, *, w: int, n_accounts: int,
               gen_new: bool = True, hot_frac=None, hot_prob=None, mix=None,
-              use_pallas: bool = False,
+              use_pallas: bool = False, use_hotset: bool = False,
               counters: mon.Counters | None = None):
     """One fused device step: wave 1 of a NEW cohort acquires against c1's
     STILL-HELD stamps (stamp == step-1), then wave 2 installs c1's writes.
@@ -215,6 +246,18 @@ def pipe_step(db: DenseBank, c1: BankCtx, key, *, w: int, n_accounts: int,
     bit-identical to the XLA gathers; the scatter-min arbitration and the
     install scatters stay XLA (they are already 1-D unique-index fast
     paths).
+
+    ``use_hotset`` (static) serves those same gathers through the
+    dintcache partition instead (db must carry the hot mirror —
+    attach_hotset): hot lanes (account < hot_n) read the compact mirror
+    (VMEM-resident inside the pallas kernel, a small-array gather on the
+    XLA route) while cold lanes walk the full tables, and the wave-2
+    install writes through to the mirror (the fused
+    ops/pallas_gather.scatter_rows_hot kernel on the pallas route, a
+    double 1-D unique-index scatter on XLA). At the workload's 90%/4%
+    skew this converts the dominant random-HBM row DMAs into VMEM
+    accesses; outputs stay bit-identical to the default path (pinned in
+    tests/test_hotset.py).
 
     ``counters`` (monitor.Counters | None): the dintmon counter plane —
     txn outcomes from c1's completing stats, S/X arbitration won-vs-lost
@@ -254,13 +297,27 @@ def pipe_step(db: DenseBank, c1: BankCtx, key, *, w: int, n_accounts: int,
     is_s_lane = (l_op == Op.ACQ_S_READ).reshape(-1)
     lane = jnp.arange(w * L, dtype=I32)
 
+    # dintcache partition: a lane is hot iff its account sits in the
+    # mirrored prefix; mirror index = tbl*hot_n + acc. Stamps share the
+    # same mapping in the exact slot regime (slot == row).
+    hn = db.hot_n
+    stamp_hot = use_hotset and db.hot_x is not None
+    if use_hotset:
+        hot_lane = (active & (l_ac < hn)).reshape(-1)
+        midx = jnp.where(hot_lane, (l_tb * hn + l_ac).reshape(-1), -1)
+
     first_x = jnp.full((h,), BIG, I32).at[
         jnp.where(is_x_lane, slot, h)].min(lane, mode="drop")
     first_s = jnp.full((h,), BIG, I32).at[
         jnp.where(is_s_lane, slot, h)].min(lane, mode="drop")
     # held = stamped by the previous step's cohort (released implicitly
     # one step later; acquire-before-release semantics preserved)
-    if use_pallas:
+    if stamp_hot:
+        held_x = pg.hot_gather(db.x_step, db.hot_x, slot, midx, 1,
+                               use_pallas=use_pallas) == t - 1
+        held_s = pg.hot_gather(db.s_step, db.hot_s, slot, midx, 1,
+                               use_pallas=use_pallas) == t - 1
+    elif use_pallas:
         held_x = pg.gather_rows(db.x_step, slot, 1) == t - 1
         held_s = pg.gather_rows(db.s_step, slot, 1) == t - 1
     else:
@@ -273,9 +330,20 @@ def pipe_step(db: DenseBank, c1: BankCtx, key, *, w: int, n_accounts: int,
     x_step = db.x_step.at[jnp.where(grant_x, slot, h)].set(
         t, mode="drop", unique_indices=True)
     # one writer per slot: the first S lane stamps for all sharers
+    s_writer = grant_s & (first_s[slot] == lane)
     s_step = db.s_step.at[
-        jnp.where(grant_s & (first_s[slot] == lane), slot, h)].set(
+        jnp.where(s_writer, slot, h)].set(
         t, mode="drop", unique_indices=True)
+    hot_x, hot_s = db.hot_x, db.hot_s
+    if stamp_hot:
+        # stamp write-through: the grant masks are one-writer-per-slot, so
+        # their hot subsets are one-writer-per-mirror-index
+        hot_x = hot_x.at[jnp.where(grant_x & (midx >= 0), midx,
+                                   2 * hn)].set(t, mode="drop",
+                                                unique_indices=True)
+        hot_s = hot_s.at[jnp.where(s_writer & (midx >= 0), midx,
+                                   2 * hn)].set(t, mode="drop",
+                                                unique_indices=True)
 
     granted = (grant_x | grant_s).reshape(w, L)
     lock_rejected = (active & ~granted).any(axis=1)
@@ -283,8 +351,12 @@ def pipe_step(db: DenseBank, c1: BankCtx, key, *, w: int, n_accounts: int,
 
     # fused reads from the pre-install table: rows c1 installs below were
     # X-stamped by c1, so this cohort never granted (or consumed) them
-    raw_bal = (pg.gather_rows(db.bal, flat_rows, 1) if use_pallas
-               else db.bal[flat_rows])
+    if use_hotset:
+        raw_bal = pg.hot_gather(db.bal, db.hot_bal, flat_rows, midx, 1,
+                                use_pallas=use_pallas)
+    else:
+        raw_bal = (pg.gather_rows(db.bal, flat_rows, 1) if use_pallas
+                   else db.bal[flat_rows])
     bal = jnp.where(granted, raw_bal.astype(I32).reshape(w, L), 0)
 
     nw, do, logic_abort, commit, committed = compute_phase(
@@ -309,8 +381,21 @@ def pipe_step(db: DenseBank, c1: BankCtx, key, *, w: int, n_accounts: int,
     dwf = c1.do_write.reshape(-1)
     wrows = jnp.where(dwf, c1.rows.reshape(-1), oob)       # [wL]
     newbal = c1.nw.reshape(-1)
-    bal_new = db.bal.at[wrows].set(newbal.astype(U32), mode="drop",
-                                   unique_indices=True)
+    if use_hotset:
+        # partitioned install: the full table AND the hot mirror take the
+        # write (one fused kernel on the pallas route, a double 1-D
+        # unique-index scatter on XLA) — the write-through that keeps
+        # mirror == table prefix an invariant instead of a protocol
+        w_acc = c1.acc.reshape(-1)
+        w_midx = jnp.where(dwf & (w_acc < hn),
+                           c1.tbl.reshape(-1) * hn + w_acc, -1)
+        bal_new, hot_bal = pg.hot_scatter(
+            db.bal, db.hot_bal, c1.rows.reshape(-1), w_midx, dwf,
+            newbal.astype(U32), 1, use_pallas=use_pallas)
+    else:
+        hot_bal = db.hot_bal
+        bal_new = db.bal.at[wrows].set(newbal.astype(U32), mode="drop",
+                                       unique_indices=True)
 
     newval = jnp.zeros((wrows.shape[0], VW), U32)
     newval = newval.at[:, 0].set(newbal.astype(U32))
@@ -324,13 +409,29 @@ def pipe_step(db: DenseBank, c1: BankCtx, key, *, w: int, n_accounts: int,
                               c1.acc.reshape(-1).astype(U32), stepv, newval)
 
     db = db.replace(bal=bal_new, x_step=x_step, s_step=s_step,
-                    step=t + 1, log=logs)
+                    step=t + 1, log=logs, hot_bal=hot_bal,
+                    hot_x=hot_x, hot_s=hot_s)
     if counters is not None:
         act_l = active.reshape(-1)
         grant_l = granted.reshape(-1)
         held_l = held_x | held_s            # [wL] slot stamped last step
         rej_l = act_l & ~grant_l
+        hot_ctrs = {}
+        if use_hotset:
+            # partition accounting: every hot-partitioned gather serves
+            # (midx >= 0) lanes from the mirror and the rest via cold row
+            # DMAs; the mirror refresh is one bulk DMA per pallas gather
+            # invocation (0 on the XLA partition route)
+            n_g = 1 + (2 if stamp_hot else 0)
+            hits = (midx >= 0).sum(dtype=I32)
+            hot_ctrs = {
+                mon.CTR_HOT_HITS: n_g * hits,
+                mon.CTR_HOT_COLD_ROWS: n_g * (w * L) - n_g * hits,
+                mon.CTR_HOT_REFRESH_BYTES:
+                    (n_g * 2 * hn * 4) if use_pallas else 0,
+            }
         counters = mon.bump(counters, {
+            **hot_ctrs,
             mon.CTR_STEPS: 1,
             mon.CTR_TXN_ATTEMPTED: c1.attempted,
             mon.CTR_TXN_COMMITTED: c1.committed,
@@ -356,7 +457,7 @@ def pipe_step(db: DenseBank, c1: BankCtx, key, *, w: int, n_accounts: int,
 def build_pipelined_runner(n_accounts: int, w: int = 8192,
                            cohorts_per_block: int = 8, hot_frac=None,
                            hot_prob=None, mix=None, use_pallas=None,
-                           monitor: bool = False):
+                           use_hotset=None, monitor: bool = False):
     """jit(scan(pipe_step)) over carry (db, c1). Returns (run, init, drain):
       run(carry, key) -> (carry', stats [cohorts_per_block, N_STATS])
       init(db)        -> carry with one bootstrap cohort in flight
@@ -365,12 +466,29 @@ def build_pipelined_runner(n_accounts: int, w: int = 8192,
     ``use_pallas``: None = honor DINT_USE_PALLAS env; Mosaic failure falls
     back to the XLA gathers (ops/pallas_gather.resolve_use_pallas).
 
+    ``use_hotset``: None = honor DINT_USE_HOTSET env. Serves the step's
+    random gathers through the dintcache hot/cold partition; the hot set
+    defaults to the WORKLOAD's hot set (``hot_frac``, else the SmallBank
+    90%/4% skew constant) so the mirror covers exactly the keys the skew
+    concentrates on. init() attaches the mirror to a db that lacks one.
+    A Mosaic rejection of the hot kernels degrades the serving backend to
+    the XLA index-compare partition, never the split itself.
+
     ``monitor``: thread the dintmon counter plane — the carry grows a
     trailing monitor.Counters leaf and drain returns (db, stats,
     counters); off (default) = contract and jaxpr unchanged.
     """
+    from ..clients import workloads as wl
+    use_hotset = pg.resolve_use_hotset(use_hotset)
     use_pallas = pg.resolve_use_pallas(use_pallas, n_idx=w * L, m_lock=None)
-    kw = dict(w=w, n_accounts=n_accounts, use_pallas=use_pallas)
+    hot_n = 0
+    if use_hotset:
+        frac = wl.SB_HOT_FRAC if hot_frac is None else float(hot_frac)
+        hot_n = max(1, min(int(n_accounts * frac), n_accounts))
+        if use_pallas and not pg.hot_kernels_available(n_idx=w * L):
+            use_pallas = False      # partition stays; XLA serves it
+    kw = dict(w=w, n_accounts=n_accounts, use_pallas=use_pallas,
+              use_hotset=use_hotset)
     kw_gen = dict(kw, hot_frac=hot_frac, hot_prob=hot_prob, mix=mix)
 
     def step_mon(db, c1, key, cnt, **skw):
@@ -389,6 +507,8 @@ def build_pipelined_runner(n_accounts: int, w: int = 8192,
         return jax.lax.scan(scan_fn, carry, keys)
 
     def init(db):
+        if use_hotset and db.hot_n == 0:
+            db = attach_hotset(db, hot_n)
         base = (db, empty_ctx(w))
         return base + ((mon.create(),) if monitor else ())
 

@@ -22,10 +22,12 @@ sequential oracle in dint_tpu.testing.oracle):
 """
 from __future__ import annotations
 
+import flax.struct
 import jax
 import jax.numpy as jnp
 
 from ..ops import hashing, segments
+from ..ops import pallas_gather as pg
 from ..tables import kv
 from .types import Batch, Op, Replies, Reply
 
@@ -33,14 +35,53 @@ I32 = jnp.int32
 U32 = jnp.uint32
 
 
-def step(table: kv.KVTable, batch: Batch, *, maintain_bloom: bool = False):
-    """One server step: certify and apply a batch. Returns (table', replies).
+@flax.struct.dataclass
+class HotKV:
+    """dintcache hot tier for the store engine: a key-indexed write-through
+    mirror of keys (0, k) with key_lo < hot_n and key_hi == 0 — the head
+    of the store benchmark's Zipfian distribution, whose rank IS the key
+    id (clients/workloads.zipf_keys). The mirror replaces the val/ver
+    gathers of the probe for hot lanes (a VMEM-resident small array in
+    the pallas kernel, a small-array gather on XLA); installs write
+    through, so mirror == table for every key the probe can hit. Mirror
+    entries of ABSENT keys are stale by design: every consumer of
+    val0/ver0 in step() is masked by hit0."""
+    val: jax.Array    # u32 [hot_n * VW]
+    ver: jax.Array    # u32 [hot_n]
+
+    @property
+    def hot_n(self):
+        return self.ver.shape[0]
+
+
+def attach_hot(table: kv.KVTable, hot_n: int) -> HotKV:
+    """Build the hot mirror for key ids [0, hot_n) from the current table
+    (one batched probe; run after populate)."""
+    hot_n = max(int(hot_n), 1)
+    klo = jnp.arange(hot_n, dtype=U32)
+    khi = jnp.zeros((hot_n,), U32)
+    b1, b2 = hashing.bucket_pair(khi, klo, table.n_buckets)
+    hit, _, _, val, ver, _, _ = kv.probe(table, khi, klo, b1, b2)
+    return HotKV(val=jnp.where(hit[:, None], val, U32(0)).reshape(-1),
+                 ver=jnp.where(hit, ver, U32(0)))
+
+
+def step(table: kv.KVTable, batch: Batch, *, maintain_bloom: bool = False,
+         hot: HotKV | None = None, use_pallas: bool = False):
+    """One server step: certify and apply a batch. Returns (table', replies)
+    — or (table', replies, hot') when the dintcache hot tier is threaded.
 
     ``maintain_bloom`` (static) keeps per-bucket bloom filters exact across
     inserts/deletes. The full-table fast path doesn't need them (probe() is
     exact); they exist for cache-mode parity with the reference's negative
     lookups (store/ebpf/store_kern.c:88-95) and cost a hash per slot per
     touched bucket, so they're off by default.
+
+    ``hot`` (a HotKV, or None = off): serve hot keys' val/ver reads from
+    the mirror and write installs through to it — replies and table are
+    bit-identical to the default path (tests/test_hotset.py).
+    ``use_pallas`` (static) routes the partitioned gathers/install
+    through the ops/pallas_gather hot kernels.
     """
     r = batch.width
     sb = segments.sort_batch(batch.key_hi, batch.key_lo)
@@ -48,8 +89,22 @@ def step(table: kv.KVTable, batch: Batch, *, maintain_bloom: bool = False):
     val_in = batch.val[sb.perm]
 
     b1, b2 = hashing.bucket_pair(sb.key_hi, sb.key_lo, table.n_buckets)
-    hit0, fbkt, slot0, val0, ver0, free1, free2 = kv.probe(
-        table, sb.key_hi, sb.key_lo, b1, b2)
+    if hot is None:
+        hit0, fbkt, slot0, val0, ver0, free1, free2 = kv.probe(
+            table, sb.key_hi, sb.key_lo, b1, b2)
+    else:
+        hot_n = hot.hot_n
+        vw = table.val_words
+        hit0, fbkt, slot0, free1, free2 = kv.probe_loc(
+            table, sb.key_hi, sb.key_lo, b1, b2)
+        eidx0 = fbkt * table.slots + slot0
+        kmidx = jnp.where((sb.key_hi == U32(0))
+                          & (sb.key_lo < U32(hot_n)),
+                          sb.key_lo.astype(I32), -1)
+        val0 = pg.hot_gather(table.val, hot.val, eidx0, kmidx, vw,
+                             use_pallas=use_pallas).reshape(r, vw)
+        ver0 = pg.hot_gather(table.ver, hot.ver, eidx0, kmidx, 1,
+                             use_pallas=use_pallas)
     # insert destination: the emptier of the two candidate buckets
     dest = jnp.where(free2 > free1, b2, b1)
     bkt = jnp.where(hit0, fbkt, dest)
@@ -157,14 +212,33 @@ def step(table: kv.KVTable, batch: Batch, *, maintain_bloom: bool = False):
     wv = (o_upd | ok)
     sl_v = jnp.where(o_upd, o_slot0, slot_new)
     e_v = jnp.where(wv, o_bkt * s + sl_v, ne)
+    if hot is None:
+        val_new = table.val.at[kv.val_word_idx(table, e_v)].set(
+            o_val.reshape(-1), mode="drop", unique_indices=True)
+        ver_new = table.ver.at[e_v].set(o_ver, mode="drop",
+                                        unique_indices=True)
+    else:
+        # write-through install: table entry AND key-indexed mirror (one
+        # fused kernel on the pallas route). One writer per key segment,
+        # so entry AND mirror indices are unique among masked lanes.
+        w_midx = jnp.where(wv & (o_khi == U32(0))
+                           & (o_klo < U32(hot_n)),
+                           o_klo.astype(I32), -1)
+        e_w = o_bkt * s + sl_v
+        val_new, hot_val = pg.hot_scatter(
+            table.val, hot.val, e_w, w_midx, wv, o_val.reshape(-1), vw,
+            use_pallas=use_pallas)
+        ver_new, hot_ver = pg.hot_scatter(
+            table.ver, hot.ver, e_w, w_midx, wv, o_ver, 1,
+            use_pallas=use_pallas)
+        hot = hot.replace(val=hot_val, ver=hot_ver)
     table = table.replace(
         key_hi=table.key_hi.at[e_v].set(o_khi, mode="drop",
                                         unique_indices=True),
         key_lo=table.key_lo.at[e_v].set(o_klo, mode="drop",
                                         unique_indices=True),
-        val=table.val.at[kv.val_word_idx(table, e_v)].set(
-            o_val.reshape(-1), mode="drop", unique_indices=True),
-        ver=table.ver.at[e_v].set(o_ver, mode="drop", unique_indices=True),
+        val=val_new,
+        ver=ver_new,
         valid=new_valid,
     )
     if maintain_bloom:
@@ -174,4 +248,6 @@ def step(table: kv.KVTable, batch: Batch, *, maintain_bloom: bool = False):
     o_rtype, o_rver = segments.unsort(sb, rtype, rver)
     o_rval = segments.unsort(sb, rval)
     replies = Replies(rtype=o_rtype, val=o_rval, ver=o_rver)
+    if hot is not None:
+        return table, replies, hot
     return table, replies

@@ -147,6 +147,15 @@ class DenseDB:
                         #   "never held", so step-1 must never be 0)
     log: logring.RepLog   # 3 replica entries packed per slot (log x3)
     val_words: int = flax.struct.field(pytree_node=False, default=10)
+    # dintcache hot tier (round 10; OFF by default — TATP is uniform, the
+    # partition is exposed for skewed-TATP experiments): the hot set is
+    # the flat ROW prefix [0, hot_n), which covers the subscriber-table
+    # prefix — the table every transaction touches. hot_meta/hot_val are
+    # physical write-through mirrors of that prefix; the arb prefix needs
+    # no mirror (lock_arbitrate caches it in VMEM for the pass).
+    hot_meta: jax.Array | None = None   # u32 [hot_n]
+    hot_val: jax.Array | None = None    # u32 [hot_n * VW]
+    hot_n: int = flax.struct.field(pytree_node=False, default=0)
 
     @property
     def n_sub(self):
@@ -307,6 +316,15 @@ def populate_device(key, n_sub: int, val_words: int = 10, **kw) -> DenseDB:
     return db.replace(val=val, meta=meta)
 
 
+def attach_hotset(db: DenseDB, hot_rows: int) -> DenseDB:
+    """Build the hot mirror for the flat row prefix [0, hot_rows) from the
+    current tables (DenseDB docstring; skewed-TATP experiments)."""
+    hot_rows = int(min(max(int(hot_rows), 1), n_rows(db.n_sub)))
+    return db.replace(hot_meta=db.meta[:hot_rows],
+                      hot_val=db.val[:hot_rows * db.val_words],
+                      hot_n=hot_rows)
+
+
 # ---------------------------------------------------------------- pipeline
 
 
@@ -377,7 +395,7 @@ class Installs:
 def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
               n_sub: int, val_words: int, gen_new: bool = True, mix=None,
               emit_installs: bool = False, check_magic: bool = True,
-              use_pallas: bool = False,
+              use_pallas: bool = False, use_hotset: bool = False,
               counters: mon.Counters | None = None):
     """One fused device step: commit wave of c2, validate wave of c1, and
     read+lock wave of a NEW cohort — ordered commits -> reads -> locks per
@@ -396,6 +414,13 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
     XLA path (tests/test_pallas_ops.py); builders resolve the flag via
     pg.resolve_use_pallas, which degrades to False when Mosaic rejects a
     kernel.
+
+    ``use_hotset`` (static; OFF by default — TATP is uniform) serves the
+    meta/magic gathers through the dintcache row-prefix partition (db must
+    carry the mirror — attach_hotset), write-through at the wave-3
+    installs, and caches the arb prefix in VMEM inside the fused lock
+    pass. Bit-identical to the default path (tests/test_hotset.py);
+    exposed for skewed-TATP experiments.
 
     ``counters`` (a monitor.Counters, or None = off): the device-resident
     counter plane. When threaded, the step bumps the dintmon registry
@@ -431,8 +456,8 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
     #                                 was X-held since, so still current
     meta_new = (((vv >> 1) + 1) << 1) | newex.astype(U32)
     wrows = jnp.where(wmask, c2.ws_rows.reshape(-1), oob)       # [2w]
-    meta = db.meta.at[wrows].set(meta_new, mode="drop",
-                                 unique_indices=True)
+    hn = db.hot_n
+    hot_meta, hot_val = db.hot_meta, db.hot_val
     payload = jax.random.randint(kv3, (w, 2), 0, 1 << 16, dtype=I32)
     newval = jnp.zeros((w, 2, val_words), U32)
     newval = newval.at[:, :, 0].set(payload.astype(U32))
@@ -440,13 +465,28 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
         jnp.where(do_write & (c2.ws_kind != 2), U32(MAGIC), U32(0)))
     newval = newval.reshape(-1, val_words)
     newval = jnp.where((wkind == 2)[:, None], U32(0), newval)   # delete zeroes
-    # interleaved-1-D install: row r's words live at [r*VW, (r+1)*VW); the
-    # masked-lane oob row lands at n1*VW >= len and drops (same discipline
-    # as parallel/dense_sharded._apply_backup)
-    wflat = (wrows[:, None] * val_words
-             + jnp.arange(val_words, dtype=I32)).reshape(-1)
-    val = db.val.at[wflat].set(newval.reshape(-1), mode="drop",
-                               unique_indices=True)
+    if use_hotset:
+        # partitioned write-through install: the row prefix is the hot
+        # set, so mirror index == row for hot rows (fused kernel on the
+        # pallas route, double 1-D unique-index scatters on XLA)
+        wsr = c2.ws_rows.reshape(-1)
+        w_midx = jnp.where(wmask & (wsr < hn), wsr, -1)
+        meta, hot_meta = pg.hot_scatter(db.meta, hot_meta, wsr, w_midx,
+                                        wmask, meta_new, 1,
+                                        use_pallas=use_pallas)
+        val, hot_val = pg.hot_scatter(db.val, hot_val, wsr, w_midx,
+                                      wmask, newval.reshape(-1),
+                                      val_words, use_pallas=use_pallas)
+    else:
+        meta = db.meta.at[wrows].set(meta_new, mode="drop",
+                                     unique_indices=True)
+        # interleaved-1-D install: row r's words live at [r*VW, (r+1)*VW);
+        # the masked-lane oob row lands at n1*VW >= len and drops (same
+        # discipline as parallel/dense_sharded._apply_backup)
+        wflat = (wrows[:, None] * val_words
+                 + jnp.arange(val_words, dtype=I32)).reshape(-1)
+        val = db.val.at[wflat].set(newval.reshape(-1), mode="drop",
+                                   unique_indices=True)
 
     newver = (vv >> 1) + 1
     flags_del = (wkind == 2).astype(I32)
@@ -482,7 +522,12 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
     # halves per-op launch/descriptor overhead on ops measured at
     # 0.6-0.9 ms per 16-32k random indices
     gidx = jnp.concatenate([c1.rows.reshape(-1), rows.reshape(-1)])
-    g = pg.gather_rows(meta, gidx, 1) if use_pallas else meta[gidx]
+    if use_hotset:
+        g_midx = jnp.where(gidx < hn, gidx, -1)
+        g = pg.hot_gather(meta, hot_meta, gidx, g_midx, 1,
+                          use_pallas=use_pallas)
+    else:
+        g = pg.gather_rows(meta, gidx, 1) if use_pallas else meta[gidx]
     vvB = g[: w * K].reshape(w, K)                              # [w, K]
     rmeta = g[w * K:].reshape(w, K)                             # [w, K]
 
@@ -507,8 +552,15 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
         # measurement knob (DINT_BENCH_CHECK_MAGIC=0) quantifying it —
         # the default keeps the reference's every-read integrity check
         midx = (rows * val_words + 1).reshape(-1)
-        rmagic = (pg.gather_rows(val, midx, 1).reshape(w, K)
-                  if use_pallas else val[midx].reshape(w, K))
+        if use_hotset:
+            # the mirror is the flat word prefix [0, hn*VW): a hot row's
+            # magic word sits at the same flat offset in it
+            mg_midx = jnp.where((rows < hn).reshape(-1), midx, -1)
+            rmagic = pg.hot_gather(val, hot_val, midx, mg_midx, 1,
+                                   use_pallas=use_pallas).reshape(w, K)
+        else:
+            rmagic = (pg.gather_rows(val, midx, 1).reshape(w, K)
+                      if use_pallas else val[midx].reshape(w, K))
         magic_bad = jnp.sum(is_read & rex & (rmagic != MAGIC), dtype=I32)
     else:
         magic_bad = jnp.asarray(0, I32)
@@ -538,7 +590,10 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
         # scatter-max + winner read-back in ONE launch, arb updated in
         # place (bit-identical to the XLA chain below — pinned in
         # tests/test_pallas_ops.py)
-        arb, grant_u = pg.lock_arbitrate(db.arb, flat_ws, active, t, K_ARB)
+        # hot_n > 0 caches the arb prefix in VMEM for the pass (dintcache);
+        # outputs bit-identical either way
+        arb, grant_u = pg.lock_arbitrate(db.arb, flat_ws, active, t, K_ARB,
+                                         hot_n=hn if use_hotset else 0)
         grant = (grant_u != 0).reshape(w, 2)
     else:
         arb_old = db.arb[flat_ws]   # [2w]; sentinel row is never stamped
@@ -574,10 +629,28 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
         ab_validate=jnp.asarray(0, I32),
         magic_bad=magic_bad)
 
-    db = db.replace(val=val, meta=meta, arb=arb, step=t + 1, log=logs)
+    db = db.replace(val=val, meta=meta, arb=arb, step=t + 1, log=logs,
+                    hot_meta=hot_meta, hot_val=hot_val)
     if counters is not None:
         grant_l = grant.reshape(-1)
+        hot_ctrs = {}
+        if use_hotset:
+            # partition accounting over the meta + magic gathers (the arb
+            # prefix residency has no per-lane split to count)
+            hits = (g_midx >= 0).sum(dtype=I32)
+            lanes = 2 * w * K
+            refresh = hn * 4
+            if check_magic:
+                hits = hits + (mg_midx >= 0).sum(dtype=I32)
+                lanes += w * K
+                refresh += hn * val_words * 4
+            hot_ctrs = {
+                mon.CTR_HOT_HITS: hits,
+                mon.CTR_HOT_COLD_ROWS: lanes - hits,
+                mon.CTR_HOT_REFRESH_BYTES: refresh if use_pallas else 0,
+            }
         counters = mon.bump(counters, {
+            **hot_ctrs,
             mon.CTR_STEPS: 1,
             mon.CTR_TXN_ATTEMPTED: c2.attempted,
             mon.CTR_TXN_COMMITTED: (c2.ro_commit | c2.alive).sum(dtype=I32),
@@ -633,6 +706,7 @@ def rebase_stamps(db: DenseDB) -> DenseDB:
 def build_pipelined_runner(n_sub: int, w: int = 8192, val_words: int = 10,
                            cohorts_per_block: int = 8, mix=None,
                            check_magic: bool = True, use_pallas=None,
+                           use_hotset: bool = False, hot_frac=None,
                            monitor: bool = False):
     """jit(scan(pipe_step)) over carry (db, c1, c2); same contract as
     tatp_pipeline.build_pipelined_runner: returns (run, init, drain).
@@ -642,16 +716,32 @@ def build_pipelined_runner(n_sub: int, w: int = 8192, val_words: int = 10,
     geometry and a Mosaic failure falls back to the XLA path with a logged
     warning (ops/pallas_gather.resolve_use_pallas).
 
+    ``use_hotset`` / ``hot_frac``: the dintcache row-prefix partition,
+    OFF by default and deliberately NOT env-driven here — TATP's NURand
+    workload is near-uniform, so the hot tier only pays at this engine
+    unless the experiment skews it; pass use_hotset=True (hot_frac = the
+    mirrored fraction of the subscriber prefix, default 4%) for
+    skewed-TATP experiments. init() attaches the mirror.
+
     ``monitor``: thread the dintmon counter plane through the carry. The
     carry grows a trailing monitor.Counters leaf (init creates it; read
     it between dispatches with monitor.snapshot(carry[-1])) and drain
     returns (db, stats, counters). Off (default) = contract and jaxpr
     unchanged, outputs bit-identical."""
     assert 2 * w <= (1 << K_ARB), f"w={w} exceeds the arb slot field"
+    use_hotset = bool(use_hotset)
     use_pallas = pg.resolve_use_pallas(use_pallas, n_idx=2 * w * K,
                                        m_lock=2 * w, k_arb=K_ARB)
+    hot_rows = 0
+    if use_hotset:
+        frac = 0.04 if hot_frac is None else float(hot_frac)
+        hot_rows = max(1, min(int((n_sub + 1) * frac), n_rows(n_sub)))
+        if use_pallas and not pg.hot_kernels_available(
+                n_idx=2 * w * K, m_lock=2 * w, k_arb=K_ARB):
+            use_pallas = False      # partition stays; XLA serves it
     kw = dict(w=w, n_sub=n_sub, val_words=val_words,
-              check_magic=check_magic, use_pallas=use_pallas)
+              check_magic=check_magic, use_pallas=use_pallas,
+              use_hotset=use_hotset)
 
     def step_mon(db, c1, c2, key, cnt, **skw):
         """pipe_step + (counters or None), normalized to a fixed arity."""
@@ -673,6 +763,8 @@ def build_pipelined_runner(n_sub: int, w: int = 8192, val_words: int = 10,
         return jax.lax.scan(scan_fn, (db,) + carry[1:], keys)
 
     def init(db):
+        if use_hotset and db.hot_n == 0:
+            db = attach_hotset(db, hot_rows)
         base = (db, empty_ctx(w), empty_ctx(w))
         return base + ((mon.create(),) if monitor else ())
 

@@ -94,6 +94,16 @@ _REGISTRY: tuple[tuple[str, str, str], ...] = (
      "steps whose random-access ops ran the XLA path"),
     ("dispatch_pallas", FLOW,
      "steps whose random-access ops ran the Pallas DMA-ring kernels"),
+    ("hot_hits", FLOW,
+     "hot-partition gather lanes served from the dintcache mirror "
+     "(DINT_USE_HOTSET; hot_hits + hot_cold_rows = partitioned lanes)"),
+    ("hot_cold_rows", FLOW,
+     "hot-partition gather lanes that fell through to cold full-table "
+     "row access (the DMA ring on pallas, the big-array gather on XLA)"),
+    ("hot_refresh_bytes", FLOW,
+     "bytes of hot-mirror bulk refresh DMA'd to VMEM by the pallas hot "
+     "kernels (one mirror copy per partitioned gather; 0 on the XLA "
+     "partition route, which has no residency to refresh)"),
 )
 
 ALL_NAMES: tuple[str, ...] = tuple(n for n, _, _ in _REGISTRY)
@@ -127,6 +137,9 @@ CTR_ROUTE_OVERFLOW = COUNTER_INDEX["route_overflow"]
 CTR_RING_HWM = COUNTER_INDEX["ring_hwm"]
 CTR_DISPATCH_XLA = COUNTER_INDEX["dispatch_xla"]
 CTR_DISPATCH_PALLAS = COUNTER_INDEX["dispatch_pallas"]
+CTR_HOT_HITS = COUNTER_INDEX["hot_hits"]
+CTR_HOT_COLD_ROWS = COUNTER_INDEX["hot_cold_rows"]
+CTR_HOT_REFRESH_BYTES = COUNTER_INDEX["hot_refresh_bytes"]
 
 # the subset defined with IDENTICAL semantics by the dense engines and
 # the generic sort-based pipelines: on the parity workloads

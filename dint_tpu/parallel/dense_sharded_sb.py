@@ -83,13 +83,26 @@ N_STATS = N_STATS + 1
 @flax.struct.dataclass
 class SBShard:
     """One device's slice: primary balances for its account range, backup
-    copies of the two predecessors' ranges, step-stamp lock tables, log."""
+    copies of the two predecessors' ranges, step-stamp lock tables, log.
+
+    The ``hot_*`` leaves are the per-device dintcache hot tier (round 10):
+    round-robin partitioning puts global hot account ``a < hot_n`` at
+    device ``a % D`` local index ``a // D``, so each device's hot set is
+    its LOCAL account prefix ``q < hot_loc`` (hot_loc = ceil(hot_n / D);
+    the mirror may cover a couple of tail accounts past the global hot_n
+    on some devices — a superset is harmless, coherence is per-row).
+    Mirror index = tbl*hot_loc + q; installs write through. The sharded
+    lock tables are exact (slot == local row), so stamps always mirror."""
     bal: jax.Array       # u32 [m1_loc]  (sentinel last)
     bck_bal: jax.Array   # u32 [N_BCK * m1_loc]
     x_step: jax.Array    # u32 [m1_loc]
     s_step: jax.Array    # u32 [m1_loc]
     step: jax.Array      # u32 scalar (starts at 2, == single-chip engine)
     log: logring.RepLog  # replicas=1: the 3 copies live on 3 devices
+    hot_bal: jax.Array | None = None   # u32 [2*hot_loc]
+    hot_x: jax.Array | None = None     # u32 [2*hot_loc]
+    hot_s: jax.Array | None = None     # u32 [2*hot_loc]
+    hot_loc: int = flax.struct.field(pytree_node=False, default=0)
 
 
 def n_acct_local(n_accounts: int, d: int) -> int:
@@ -98,6 +111,22 @@ def n_acct_local(n_accounts: int, d: int) -> int:
 
 def m1_local(n_accounts: int, d: int) -> int:
     return 2 * n_acct_local(n_accounts, d) + 1
+
+
+def attach_hotset_sb(mesh: Mesh, state: SBShard, hot_loc: int) -> SBShard:
+    """Build each device's hot mirror from its current local tables
+    (leaves here are the stacked [D, ...] arrays)."""
+    n_loc = state.bal.shape[1] // 2
+    hot_loc = int(min(max(int(hot_loc), 1), n_loc))
+    idx = jnp.concatenate([jnp.arange(hot_loc, dtype=I32),
+                           n_loc + jnp.arange(hot_loc, dtype=I32)])
+    shard = NamedSharding(mesh, P(AXIS))
+    put = lambda x: jax.device_put(x, shard)    # noqa: E731
+    return state.replace(
+        hot_bal=put(state.bal[:, idx]),
+        hot_x=put(state.x_step[:, idx]),
+        hot_s=put(state.s_step[:, idx]),
+        hot_loc=hot_loc)
 
 
 def create_sharded_sb(mesh: Mesh, n_shards: int, n_accounts: int,
@@ -188,7 +217,8 @@ def _stats_of(c: SBCtx):
 def build_sharded_sb_runner(mesh: Mesh, n_shards: int, n_accounts: int,
                             w: int = 2048, cohorts_per_block: int = 8,
                             hot_frac=None, hot_prob=None, mix=None,
-                            use_pallas=None, monitor: bool = False):
+                            use_pallas=None, use_hotset=None,
+                            monitor: bool = False):
     """jit(shard_map(scan(step))). Contract mirrors the single-chip dense
     runner: (run, init, drain); stats are psummed across the mesh.
 
@@ -196,6 +226,12 @@ def build_sharded_sb_runner(mesh: Mesh, n_shards: int, n_accounts: int,
     held-stamp and balance gathers through the DMA-ring kernel
     (ops/pallas_gather.gather_rows) on each device's local arrays; Mosaic
     failure falls back to the XLA gathers (logged warning).
+
+    ``use_hotset``: None = honor DINT_USE_HOTSET env. Per-device dintcache
+    partition over the owner-side gathers (SBShard docstring): hot lanes
+    read the local mirror, installs write through; init() attaches the
+    mirror. Hot set defaults to the workload's (``hot_frac``). Outputs
+    bit-identical to the default path (tests/test_hotset.py).
 
     ``monitor``: thread the dintmon counter plane PER DEVICE. Txn
     outcomes count at the source device (where the cohort completes);
@@ -211,8 +247,17 @@ def build_sharded_sb_runner(mesh: Mesh, n_shards: int, n_accounts: int,
     sent = m1 - 1
     oob = m1
     cap = 2 * ((w * L + d - 1) // d)
+    use_hotset = pg.resolve_use_hotset(use_hotset)
     use_pallas = pg.resolve_use_pallas(use_pallas, n_idx=d * cap,
                                        m_lock=None)
+    hot_loc = 0
+    if use_hotset:
+        from ..clients import workloads as wl
+        frac = wl.SB_HOT_FRAC if hot_frac is None else float(hot_frac)
+        hot_n = max(1, min(int(n_accounts * frac), n_accounts))
+        hot_loc = min((hot_n + d - 1) // d, n_loc)
+        if use_pallas and not pg.hot_kernels_available(n_idx=d * cap):
+            use_pallas = False      # partition stays; XLA serves it
     kw_gen = {}
     if hot_frac is not None:
         kw_gen["hot_frac"] = hot_frac
@@ -254,11 +299,26 @@ def build_sharded_sb_runner(mesh: Mesh, n_shards: int, n_accounts: int,
         is_x = r_op == Op.ACQ_X_READ
         is_s = r_op == Op.ACQ_S_READ
         rows = jnp.where(r_op != 0, r_row, sent)
+
+        def mirror_idx(rr, mask):
+            """Local row -> hot mirror index (tbl*hot_loc + q), -1 cold.
+            The sentinel row (q == n_loc) is never hot: hot_loc <= n_loc."""
+            tb = (rr >= n_loc).astype(I32)
+            q = rr - tb * n_loc
+            return jnp.where(mask & (q < hot_loc), tb * hot_loc + q, -1)
+
+        if use_hotset:
+            midx = mirror_idx(rows, r_op != 0)
         first_x = jnp.full((m1,), BIG, I32).at[
             jnp.where(is_x, rows, oob)].min(lanes, mode="drop")
         first_s = jnp.full((m1,), BIG, I32).at[
             jnp.where(is_s, rows, oob)].min(lanes, mode="drop")
-        if use_pallas:
+        if use_hotset:
+            held_x = pg.hot_gather(state.x_step, state.hot_x, rows, midx,
+                                   1, use_pallas=use_pallas) == t - 1
+            held_s = pg.hot_gather(state.s_step, state.hot_s, rows, midx,
+                                   1, use_pallas=use_pallas) == t - 1
+        elif use_pallas:
             held_x = pg.gather_rows(state.x_step, rows, 1) == t - 1
             held_s = pg.gather_rows(state.s_step, rows, 1) == t - 1
         else:
@@ -268,13 +328,28 @@ def build_sharded_sb_runner(mesh: Mesh, n_shards: int, n_accounts: int,
         x_wins = (first_x[rows] < first_s[rows]) & slot_free
         grant_x = is_x & x_wins & (first_x[rows] == lanes)
         grant_s = is_s & ~held_x & ~x_wins
+        s_writer = grant_s & (first_s[rows] == lanes)
         x_step = state.x_step.at[jnp.where(grant_x, rows, oob)].set(
             t, mode="drop", unique_indices=True)
         s_step = state.s_step.at[
-            jnp.where(grant_s & (first_s[rows] == lanes), rows, oob)].set(
+            jnp.where(s_writer, rows, oob)].set(
             t, mode="drop", unique_indices=True)
-        raw_bal = (pg.gather_rows(state.bal, rows, 1) if use_pallas
-                   else state.bal[rows])
+        hot_x, hot_s = state.hot_x, state.hot_s
+        if use_hotset:
+            # stamp write-through (one-writer grant masks stay unique on
+            # the mirror's index subset)
+            hot_x = hot_x.at[jnp.where(grant_x & (midx >= 0), midx,
+                                       2 * hot_loc)].set(
+                t, mode="drop", unique_indices=True)
+            hot_s = hot_s.at[jnp.where(s_writer & (midx >= 0), midx,
+                                       2 * hot_loc)].set(
+                t, mode="drop", unique_indices=True)
+        if use_hotset:
+            raw_bal = pg.hot_gather(state.bal, state.hot_bal, rows, midx,
+                                    1, use_pallas=use_pallas)
+        else:
+            raw_bal = (pg.gather_rows(state.bal, rows, 1) if use_pallas
+                       else state.bal[rows])
         g_bal = jnp.where(grant_x | grant_s, raw_bal.astype(I32), 0)
 
         # ---- replies back to sources + classify -----------------------
@@ -321,8 +396,18 @@ def build_sharded_sb_runner(mesh: Mesh, n_shards: int, n_accounts: int,
         i_mask = i_m != 0
 
         irows = jnp.where(i_mask, i_row, oob)
-        bal_new = state.bal.at[irows].set(i_bal.astype(U32), mode="drop",
-                                          unique_indices=True)
+        hot_bal = state.hot_bal
+        if use_hotset:
+            # partitioned write-through install (fused kernel on pallas,
+            # double 1-D unique-index scatter on XLA)
+            i_midx = mirror_idx(i_row, i_mask)
+            bal_new, hot_bal = pg.hot_scatter(
+                state.bal, hot_bal, i_row, i_midx, i_mask,
+                i_bal.astype(U32), 1, use_pallas=use_pallas)
+        else:
+            bal_new = state.bal.at[irows].set(i_bal.astype(U32),
+                                              mode="drop",
+                                              unique_indices=True)
 
         def mk_entry(mask, row, balv, tblv, accv, ring, bck, slot, src_dev):
             # forwarded entries tag key_hi = SOURCE device + 1 (own entries
@@ -366,8 +451,20 @@ def build_sharded_sb_runner(mesh: Mesh, n_shards: int, n_accounts: int,
                                 (dev - off) % d)
 
         state = state.replace(bal=bal_new, bck_bal=bck, x_step=x_step,
-                              s_step=s_step, step=t + 1, log=log)
+                              s_step=s_step, step=t + 1, log=log,
+                              hot_bal=hot_bal, hot_x=hot_x, hot_s=hot_s)
 
+        if cnt is not None and use_hotset:
+            # partition accounting: 3 hot-partitioned gathers per step
+            # (x/s stamps + balances), each serving (midx >= 0) lanes
+            # from the mirror; refresh = one bulk DMA per pallas gather
+            hits = (midx >= 0).sum(dtype=I32)
+            cnt = mon.bump(cnt, {
+                mon.CTR_HOT_HITS: 3 * hits,
+                mon.CTR_HOT_COLD_ROWS: 3 * (d * cap) - 3 * hits,
+                mon.CTR_HOT_REFRESH_BYTES:
+                    (3 * 2 * hot_loc * 4) if use_pallas else 0,
+            })
         if cnt is not None:
             # txn outcomes + overflow at the SOURCE (c1 completes here);
             # lock arbitration + installs at the OWNER (they ran here) —
@@ -450,6 +547,8 @@ def build_sharded_sb_runner(mesh: Mesh, n_shards: int, n_accounts: int,
         return out[:-1], out[-1]
 
     def init(state):
+        if use_hotset and state.hot_loc == 0:
+            state = attach_hotset_sb(mesh, state, hot_loc)
         base = (state, stack_leaf(_empty_sb_ctx(w)))
         return base + ((stack_leaf(mon.create()),) if monitor else ())
 

@@ -107,6 +107,21 @@ def _match_bucket(table: KVTable, key_hi, key_lo, bkt):
     return match.any(axis=-1), jnp.argmax(match, axis=-1).astype(I32), free
 
 
+def probe_loc(table: KVTable, key_hi, key_lo, b1, b2):
+    """Two-choice LOCATION probe: find each key in either candidate bucket
+    without fetching its value (the dintcache hot tier serves hot keys'
+    val/ver from its mirror, so the value gather is the caller's choice).
+
+    Returns (hit [R] bool, bkt [R] i32, slot [R] i32, free1 [R] i32,
+    free2 [R] i32)."""
+    hit1, slot1, free1 = _match_bucket(table, key_hi, key_lo, b1)
+    hit2, slot2, free2 = _match_bucket(table, key_hi, key_lo, b2)
+    hit = hit1 | hit2
+    bkt = jnp.where(hit1, b1, b2)
+    slot = jnp.where(hit1, slot1, slot2)
+    return hit, bkt, slot, free1, free2
+
+
 def probe(table: KVTable, key_hi, key_lo, b1, b2):
     """Two-choice probe: find each key in either of its two candidate buckets.
 
@@ -116,11 +131,7 @@ def probe(table: KVTable, key_hi, key_lo, b1, b2):
     buckets' free-slot counts (reusing the gathers the probe already did).
     A key lives in at most one bucket (insert picks one).
     """
-    hit1, slot1, free1 = _match_bucket(table, key_hi, key_lo, b1)
-    hit2, slot2, free2 = _match_bucket(table, key_hi, key_lo, b2)
-    hit = hit1 | hit2
-    bkt = jnp.where(hit1, b1, b2)
-    slot = jnp.where(hit1, slot1, slot2)
+    hit, bkt, slot, free1, free2 = probe_loc(table, key_hi, key_lo, b1, b2)
     eidx = bkt * table.slots + slot
     val = entry_val(table, eidx)
     ver = table.ver[eidx]

@@ -209,7 +209,7 @@ def _tatp_extras(total):
     }
 
 
-def _sb_runner(n_acc, w, cpb):
+def _sb_runner(n_acc, w, cpb, hot_frac=None, hot_prob=None):
     import jax
 
     from dint_tpu.engines import smallbank_dense as sd
@@ -221,6 +221,7 @@ def _sb_runner(n_acc, w, cpb):
         db = sd.create(n_acc)
         run, init, drain = sd.build_pipelined_runner(
             n_acc, w=w, cohorts_per_block=cpb, use_pallas=up,
+            hot_frac=hot_frac, hot_prob=hot_prob,
             monitor=_monitor_on())
         carry = init(db)
         if up:
@@ -287,11 +288,13 @@ def _metric_json(att, com, dt, p, extra):
 
 def sweep_pipeline(name, runner_fn, extras_fn, n_stats, *, widths, cpb,
                    depth, magic_idx, window_s, open_rates, results,
-                   lat_widths=()):
+                   lat_widths=(), point_extra=None):
     """Closed-loop width sweep, then open-loop rate sweep at the widest
     width relative to its measured peak, then latency-mode points
     (cohorts_per_block=1, per-step sync fetch) whose percentiles come
-    from MEASURED timestamps rather than the block-time model."""
+    from MEASURED timestamps rather than the block-time model.
+    ``point_extra`` (dict) is recorded verbatim in every point's extras
+    (skew/hot-tier provenance)."""
     peak = None
     peak_w = None
 
@@ -305,6 +308,7 @@ def sweep_pipeline(name, runner_fn, extras_fn, n_stats, *, widths, cpb,
             extra.update(cores)
             extra["mode"] = "closed"
             extra["width"] = w
+            extra.update(point_extra or {})
             # end-of-point dintmon snapshot; explicit null when off
             extra["counters"] = counters
             return _metric_json(att, com, dt, p, extra)
@@ -413,6 +417,25 @@ def sweep_micro(window_s, quick, results, want=lambda name: True):
                                      window_s) | {"width": w}
 
             run_point(results, name, store_fn)
+
+    # DINT's skewed store benchmark: Zipfian keys whose hot head is the
+    # dintcache prefix (DINT_USE_HOTSET=1 serves it from the mirror —
+    # record the A/B state in every artifact)
+    for w in widths:
+        name = f"store_zipf_w{w}"
+        if not want(name):
+            continue
+
+        def zipf_fn(w=w):
+            c = micro.StoreClient.populated(n_keys, width=w,
+                                            read_frac=0.5,
+                                            key_dist="zipfian")
+            return _timed_client(c, lambda: c.run_wave(rng), window_s) | {
+                "width": w, "key_dist": "zipfian",
+                "zipf_theta": wl.ZIPF_THETA,
+                "use_hotset": c.use_hotset, "use_pallas": c.use_pallas}
+
+        run_point(results, name, zipf_fn)
 
     if any(want(n) for n in ("lock_2pl", "lock_fasst", "lock_fasst_attr")):
         trace = wl.lock_trace(rng, n_txns=200 if quick else 20_000,
@@ -819,7 +842,9 @@ class _ResultSink(dict):
 
 
 def run_all(out: str, window_s: float = 10.0, quick: bool = False,
-            only: str | None = None, skip_done: bool = False) -> dict:
+            only: str | None = None, skip_done: bool = False,
+            hot_frac: float | None = None,
+            hot_prob: float | None = None) -> dict:
     _platform_override()
     os.makedirs(out, exist_ok=True)
     results: dict[str, dict] = _ResultSink(out, skip_done=skip_done)
@@ -854,14 +879,46 @@ def run_all(out: str, window_s: float = 10.0, quick: bool = False,
                        depth=3, magic_idx=td.STAT_MAGIC_BAD,
                        window_s=window_s, open_rates=rates, results=results,
                        lat_widths=lat_widths)
-    if want("smallbank"):
+    skew_preset = only is not None and "skew" in only
+    if want("smallbank") and not skew_preset:
+        from dint_tpu.clients import workloads as wl
         from dint_tpu.engines import smallbank_dense as sd
+        from dint_tpu.ops import pallas_gather as pg
 
-        sweep_pipeline("smallbank", lambda w, b: _sb_runner(n_acc, w, b),
+        skew_extra = {
+            "hot_frac": (wl.SB_HOT_FRAC if hot_frac is None
+                         else float(hot_frac)),
+            "hot_prob": (wl.SB_HOT_PROB if hot_prob is None
+                         else float(hot_prob)),
+            "use_hotset": pg.resolve_use_hotset(None),
+        }
+        sweep_pipeline("smallbank",
+                       lambda w, b: _sb_runner(n_acc, w, b, hot_frac,
+                                               hot_prob),
                        _sb_extras, sd.N_STATS, widths=widths, cpb=cpb,
                        depth=2, magic_idx=sd.STAT_MAGIC_BAD,
                        window_s=window_s, open_rates=rates, results=results,
-                       lat_widths=lat_widths)
+                       lat_widths=lat_widths, point_extra=skew_extra)
+
+    if skew_preset:
+        # skew-sweep preset (--only smallbank_skew): one width, hot_frac
+        # swept across the 90%-hot workload — the dintcache decision curve
+        # (arm DINT_USE_HOTSET=0/1 runs to A/B the hot tier at each skew)
+        from dint_tpu.engines import smallbank_dense as sd
+        from dint_tpu.ops import pallas_gather as pg
+
+        skew_w = 256 if quick else 8192
+        for frac in (0.01, 0.04, 0.16, 0.5):
+            sweep_pipeline(
+                f"smallbank_skew_h{int(frac * 100):02d}",
+                lambda w, b, f=frac: _sb_runner(n_acc, w, b, f, hot_prob),
+                _sb_extras, sd.N_STATS, widths=[skew_w], cpb=cpb,
+                depth=2, magic_idx=sd.STAT_MAGIC_BAD, window_s=window_s,
+                open_rates=(), results=results,
+                point_extra={"hot_frac": frac,
+                             "hot_prob": (0.9 if hot_prob is None
+                                          else float(hot_prob)),
+                             "use_hotset": pg.resolve_use_hotset(None)})
     sweep_micro(window_s, quick, results, want=want)  # self-gates per point
 
     summary = {"configs": sorted(results),
@@ -880,11 +937,19 @@ def main():
     ap.add_argument("--skip-done", action="store_true",
                     help="skip points whose non-error artifact already "
                          "exists (restart after a hang/kill)")
+    ap.add_argument("--hot-frac", type=float, default=None,
+                    help="SmallBank hot-set fraction override (default: "
+                         "the reference 4%%); the dintcache mirror "
+                         "(DINT_USE_HOTSET=1) aligns to it")
+    ap.add_argument("--hot-prob", type=float, default=None,
+                    help="SmallBank hot-set probability override "
+                         "(default: the reference 90%%)")
     args = ap.parse_args()
     if args.quick and args.window == 10.0:
         args.window = 1.0
     results = run_all(args.out, window_s=args.window, quick=args.quick,
-                      only=args.only, skip_done=args.skip_done)
+                      only=args.only, skip_done=args.skip_done,
+                      hot_frac=args.hot_frac, hot_prob=args.hot_prob)
     for name in sorted(results):
         r = results[name]
         if "error" in r:

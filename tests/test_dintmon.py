@@ -77,12 +77,12 @@ def _td_build(monitor, use_pallas=False):
 
 
 @functools.lru_cache(maxsize=None)
-def _sb_build(monitor, use_pallas=False):
+def _sb_build(monitor, use_pallas=False, use_hotset=False):
     from dint_tpu.engines import smallbank_dense as sd
 
     return sd.build_pipelined_runner(
         N_ACC, w=W, cohorts_per_block=CPB, use_pallas=use_pallas,
-        monitor=monitor)
+        use_hotset=use_hotset, monitor=monitor)
 
 
 @functools.lru_cache(maxsize=None)
@@ -166,11 +166,12 @@ def test_tatp_dense_counters_bit_identical_xla_vs_pallas():
         {k: v for k, v in b.items() if k not in drop}
 
 
-def _run_sb_dense(monitor, blocks=3, seed=1, use_pallas=False):
+def _run_sb_dense(monitor, blocks=3, seed=1, use_pallas=False,
+                  use_hotset=False):
     from dint_tpu.engines import smallbank_dense as sd
 
     db = sd.create(N_ACC)
-    run, init, drain = _sb_build(monitor, use_pallas)
+    run, init, drain = _sb_build(monitor, use_pallas, use_hotset)
     carry = init(db)
     tot = np.zeros(sd.N_STATS, np.int64)
     for i in range(blocks):
@@ -207,6 +208,39 @@ def test_sb_dense_counters_bit_identical_xla_vs_pallas():
     drop = ("dispatch_xla", "dispatch_pallas")
     assert {k: v for k, v in a.items() if k not in drop} == \
         {k: v for k, v in b.items() if k not in drop}
+
+
+def test_sb_dense_hot_counters_reconcile():
+    """dintcache counters (round 10): hot_hits + hot_cold_rows accounts
+    every partitioned gather lane (3 gathers x w*L lanes per step at this
+    exact-lock geometry), refresh bytes bill the VMEM mirror copies on
+    the pallas route only, and every pre-round-10 counter is untouched
+    by the hot tier (it changes WHERE bytes come from, not outcomes)."""
+    from dint_tpu.engines import smallbank_dense as sd
+
+    blocks = 3
+    steps = blocks * CPB + 1                 # + the drain step
+    lanes = W * sd.L
+    _, tot, base = _run_sb_dense(True)
+    db, tot_h, x = _run_sb_dense(True, use_hotset=True)
+    _, tot_p, p = _run_sb_dense(True, use_pallas=True, use_hotset=True)
+    assert tot.tolist() == tot_h.tolist() == tot_p.tolist()
+
+    hn = db.hot_n
+    assert hn == max(1, int(N_ACC * 0.04))
+    for snap in (x, p):
+        assert snap["hot_hits"] + snap["hot_cold_rows"] == 3 * steps * lanes
+        assert snap["hot_hits"] > 0          # the skew really lands hot
+    assert x["hot_refresh_bytes"] == 0       # XLA partition: no residency
+    assert p["hot_refresh_bytes"] == steps * 3 * 2 * hn * 4
+    # the hot split itself is backend-independent
+    assert x["hot_hits"] == p["hot_hits"]
+    drop = ("dispatch_xla", "dispatch_pallas", "hot_hits",
+            "hot_cold_rows", "hot_refresh_bytes")
+    assert {k: v for k, v in base.items() if k not in drop} == \
+        {k: v for k, v in x.items() if k not in drop} == \
+        {k: v for k, v in p.items() if k not in drop}
+    assert base["hot_hits"] == base["hot_cold_rows"] == 0
 
 
 # ------------------------------------------------------- generic engines

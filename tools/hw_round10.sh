@@ -1,0 +1,55 @@
+#!/bin/bash
+# Round-10 hardware measurement plan: the dintcache hot-set A/B (ISSUE 5
+# tentpole). Outage-aware like hw_round6.sh: wait for the tunnel, then land
+# the cheapest decisive artifact first — the per-op hot stage settles
+# whether the VMEM mirror beats the plain DMA ring on the skewed batch at
+# SmallBank geometry, the bench pair settles what that buys end-to-end.
+# Decision rule (PERF.md round 10): the hot tier stays off unless
+# speedup_vs_ring > 1 at SmallBank geometry AND the DINT_USE_HOTSET=1
+# bench beats the baseline's smallbank_committed_txns_per_sec.
+cd "$(dirname "$0")/.." || exit 1
+
+echo "=== stage 0: wait for the tunnel ==="
+for i in $(seq 1 200); do
+    if timeout 60 python -c "import jax; print(float(jax.numpy.ones(2).sum()))" \
+            > /dev/null 2>&1; then
+        echo "backend reachable (attempt $i)"
+        break
+    fi
+    echo "unreachable (attempt $i); sleeping 120s"
+    sleep 120
+done
+
+echo "=== stage 1: per-op hot-set A/B at SmallBank geometry ==="
+# bal-array shape: 2*24M+1 single-word rows (~192 MB), K = w*L at the
+# bench's w=8192; --hot-frac 0.04 mirrors the reference hot set (~7.7 MB,
+# VMEM-resident inside the kernel). The tool also reruns the round-6
+# meta/val/lock sections, so one artifact carries both comparisons.
+timeout 1500 python tools/profile_pallas_hbm.py --compare --hot-frac 0.04 \
+    24576 48000001 1 > pallas_hot_ab.log 2>&1 || true
+tail -3 pallas_hot_ab.log
+
+echo "=== stage 2: baseline bench (hot tier off) ==="
+DINT_BENCH_PROFILE=1 DINT_MONITOR=1 timeout 2200 python bench.py \
+    > bench_hot_off.json 2> bench_hot_off_stderr.log
+tail -1 bench_hot_off.json
+
+echo "=== stage 3: hot-set bench (XLA partition route) ==="
+DINT_USE_HOTSET=1 DINT_BENCH_PROFILE=1 DINT_MONITOR=1 \
+    timeout 2200 python bench.py \
+    > bench_hot_xla.json 2> bench_hot_xla_stderr.log
+tail -1 bench_hot_xla.json
+
+echo "=== stage 4: hot-set bench (VMEM kernels) — the tentpole measurement ==="
+DINT_USE_HOTSET=1 DINT_USE_PALLAS=1 DINT_BENCH_PROFILE=1 DINT_MONITOR=1 \
+    timeout 2200 python bench.py \
+    > bench_hot_pallas.json 2> bench_hot_pallas_stderr.log
+tail -1 bench_hot_pallas.json
+
+echo "=== stage 5: skew sweep (hot tier on vs off at each skew) ==="
+timeout 2400 python exp.py --only smallbank_skew --window 5 \
+    --out exp_results/skew_off > skew_off.log 2>&1 || true
+DINT_USE_HOTSET=1 timeout 2400 python exp.py --only smallbank_skew \
+    --window 5 --out exp_results/skew_on > skew_on.log 2>&1 || true
+
+echo "=== done ==="

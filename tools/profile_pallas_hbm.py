@@ -59,6 +59,11 @@ VAL_SCALE_ROWS = 22 * (7_000_000 + 1) + 1
 
 INTERPRET = "--interpret" in sys.argv
 COMPARE = "--compare" in sys.argv
+HOT_FRAC = None
+if "--hot-frac" in sys.argv:
+    HOT_FRAC = float(sys.argv[sys.argv.index("--hot-frac") + 1])
+    del sys.argv[sys.argv.index("--hot-frac"):
+                 sys.argv.index("--hot-frac") + 2]
 argv = [a for a in sys.argv if not a.startswith("--")]
 K = int(argv[1]) if len(argv) > 1 else (256 if INTERPRET else 32_768)
 N = int(argv[2]) if len(argv) > 2 else (10_000 if INTERPRET
@@ -167,6 +172,66 @@ def ab_lock(rng, n, m):
     }
 
 
+def ab_hot(rng, n, vw, k, hot_frac, hot_prob=0.9):
+    """The dintcache hot-tier point: a skewed index batch (hot_prob of
+    lanes in the first hot_frac of rows — the SmallBank 90%/4% shape)
+    served by XLA's gather, the plain DMA ring, and the VMEM hot-set
+    kernel (gather_rows_hot with the mirror = table prefix). The hot
+    kernel's win over the ring on this batch IS the hot tier's claim."""
+    import jax.numpy as jnp
+
+    hot_rows = max(1, int(n * hot_frac))
+    tab = jnp.asarray(rng.integers(0, 1 << 30, n * vw, np.int64)
+                      .astype(np.uint32))
+    mirror = tab[:hot_rows * vw]
+    is_hot = rng.random(k) < hot_prob
+    idx = jnp.asarray(np.where(is_hot, rng.integers(0, hot_rows, k),
+                               rng.integers(0, n, k)).astype(np.int32))
+    midx = jnp.where(idx < hot_rows, idx, -1)
+    gb = n * vw * 4 / 1e9
+    mb = hot_rows * vw * 4 / 1e6
+    print(f"--- hot point: table [{n}*{vw}] u32 = {gb:.2f} GB, mirror "
+          f"{mb:.2f} MB ({hot_frac:.0%} of rows), K={k}, "
+          f"{hot_prob:.0%} hot ---", flush=True)
+    jit_x = jax.jit(xla_gather, static_argnums=2)
+    x = timeit("xla gather", jit_x, tab, idx, vw, count=k)
+    p = timeit("pallas dma-ring gather", pg.gather_rows, tab, idx, vw,
+               count=k)
+    h = timeit("pallas hot-set gather",
+               lambda t, m, i, mi: pg.gather_rows_hot(t, m, i, mi, vw),
+               tab, mirror, idx, midx, count=k)
+    equal = None
+    if x and h:
+        a = np.asarray(jit_x(tab, idx, vw))
+        b = np.asarray(pg.gather_rows_hot(tab, mirror, idx, midx, vw))
+        equal = bool(np.array_equal(a, b))
+        print(f"outputs equal: {equal}   vs xla: "
+              f"{x / h:.2f}x   vs ring: "
+              f"{(p / h if p else float('nan')):.2f}x", flush=True)
+    return {
+        "rows": n, "vw": vw, "gb": round(gb, 3),
+        "hot_rows": hot_rows, "hot_frac": hot_frac,
+        "hot_prob": hot_prob, "mirror_mb": round(mb, 3),
+        "xla_ms": None if x is None else round(x * 1e3, 3),
+        "ring_ms": None if p is None else round(p * 1e3, 3),
+        "hot_ms": None if h is None else round(h * 1e3, 3),
+        "speedup_vs_xla": None if not (x and h) else round(x / h, 2),
+        "speedup_vs_ring": None if not (p and h) else round(p / h, 2),
+        "equal": equal,
+        "error": None,
+    }
+
+
+def _null_hot(n, vw, k, hot_frac, err):
+    hot_rows = max(1, int(n * hot_frac))
+    return {"rows": n, "vw": vw, "gb": round(n * vw * 4 / 1e9, 3),
+            "hot_rows": hot_rows, "hot_frac": hot_frac, "hot_prob": 0.9,
+            "mirror_mb": round(hot_rows * vw * 4 / 1e6, 3),
+            "xla_ms": None, "ring_ms": None, "hot_ms": None,
+            "speedup_vs_xla": None, "speedup_vs_ring": None,
+            "equal": None, "error": repr(err)[:300]}
+
+
 def _null_point(n, vw, k, err):
     """Schema-stable stand-in for an ab_point that died before measuring
     (table OOM, backend crash): every key the BENCH parser reads exists,
@@ -212,6 +277,15 @@ def main():
         except Exception as e:  # noqa: BLE001
             print(f"lock point FAILED: {repr(e)[:300]}", flush=True)
             lock = _null_lock(m, e)
+        hot = None
+        if HOT_FRAC is not None:
+            # SmallBank geometry: the bal array is single-word rows; the
+            # hot stage measures the skewed batch the workload generates
+            try:
+                hot = ab_hot(rng, rows, 1, k, HOT_FRAC)
+            except Exception as e:  # noqa: BLE001
+                print(f"hot point FAILED: {repr(e)[:300]}", flush=True)
+                hot = _null_hot(rows, 1, k, HOT_FRAC, e)
         out = {
             "metric": "pallas_gather_ab",
             "k": k,
@@ -222,8 +296,15 @@ def main():
             "meta": meta,
             "val": val,
             "lock": lock,
+            # present iff --hot-frac was passed (schema-stable otherwise:
+            # consumers see the key with explicit null)
+            "hot": hot,
         }
         print(json.dumps(out), flush=True)
+        return
+
+    if HOT_FRAC is not None:
+        ab_hot(rng, N, VW, K, HOT_FRAC)
         return
 
     if N == VAL_SCALE_ROWS and VW == 10:
