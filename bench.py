@@ -202,8 +202,13 @@ def _child_main():
             def run(carry, key, _run=bare_run):
                 carry, stats = _run(carry, key)
                 now = _time.time()
+                # defer=True double-buffers the ~100-byte counter fetch:
+                # block i-1's snapshot is materialized only after block i
+                # has been dispatched (an on-device copy keeps it alive
+                # past the carry donation), so the JSONL drain no longer
+                # serializes the dispatch stream (monitor/trace.Monitor)
                 monitor_obj.observe(carry[-1], batch=WIDTH * BLOCK,
-                                    dur_s=now - t_prev[0])
+                                    dur_s=now - t_prev[0], defer=True)
                 t_prev[0] = now
                 return carry, stats
 
@@ -233,6 +238,8 @@ def _child_main():
             trace_err = repr(e)[:200]
             carry = None
 
+    if monitor_obj is not None:
+        monitor_obj.flush()     # land the deferred final wave event
     counters_out = None
     if carry is not None:
         if monitor_on:
@@ -261,7 +268,24 @@ def _child_main():
     steady = st.steady_blocks(block_s)
     p = st.cohort_latency_percentiles(block_s, BLOCK, depth=3)
 
+    # dintscope attribution: the per-wave time breakdown of the traced
+    # steady-state block — PERF.md's closing accounting as an artifact
+    # field (object when a trace was recorded and parsed, explicit null
+    # otherwise; an attribution failure must never void the measurement)
+    from dint_tpu.monitor import attrib
+
+    breakdown = None
+    breakdown_err = None
+    if trace_dir and not trace_err:
+        try:
+            breakdown = attrib.report(
+                trace_dir, jsonl=os.environ.get("DINT_MONITOR_JSONL"),
+                geometry={"w": WIDTH, "k": td.K, "vw": VAL_WORDS})
+        except Exception as e:  # noqa: BLE001
+            breakdown_err = repr(e)[:200]
+
     out = {
+        "schema": attrib.ARTIFACT_SCHEMA,
         "metric": "tatp_committed_txns_per_sec",
         "value": round(tps, 1),
         "unit": "txn/s",
@@ -287,6 +311,9 @@ def _child_main():
         "p99_us": round(p["p99"], 1),
         "p999_us": round(p["p999"], 1),
         "lat_samples": int(p["n"]),
+        # log-bucketed histogram next to the percentile block: exact
+        # cross-window/cross-shard merges (stats.LatencyHistogram)
+        "lat_hist": p.get("hist"),
         "n_subscribers": N_SUBSCRIBERS,
         "width": WIDTH,
         # which random-access backend actually ran (pallas may have been
@@ -306,6 +333,10 @@ def _child_main():
         # object-or-explicit-null contract; filled in below so the gate
         # subprocess runs after the measurement window, not inside it)
         "dintlint": None,
+        # dintscope per-wave breakdown (object when DINT_BENCH_TRACE_DIR
+        # recorded a trace, explicit null when attribution is off)
+        "breakdown": breakdown,
+        **({"breakdown_error": breakdown_err} if breakdown_err else {}),
         **({} if check_magic else {"integrity_checks": "off (A/B knob)"}),
         "blocks": blocks,
         "window_s": round(dt, 2),

@@ -77,6 +77,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..monitor import counters as mon
+from ..monitor import waves
 from ..ops import pallas_gather as pg
 from ..tables import log as logring
 from .types import Op
@@ -279,8 +280,9 @@ def pipe_step(db: DenseBank, c1: BankCtx, key, *, w: int, n_accounts: int,
             skew["hot_frac"] = hot_frac
         if hot_prob is not None:
             skew["hot_prob"] = hot_prob
-        ttype, a1, a2 = gen_cohort(kgen, w, n_accounts, **skew)
-        l_op, l_tb, l_ac = _lock_slots(ttype, a1, a2)      # [w, L]
+        with waves.scope("smallbank_dense", "gen"):
+            ttype, a1, a2 = gen_cohort(kgen, w, n_accounts, **skew)
+            l_op, l_tb, l_ac = _lock_slots(ttype, a1, a2)  # [w, L]
     else:
         ttype = jnp.zeros((w,), I32)
         l_op = jnp.zeros((w, L), I32)
@@ -306,63 +308,66 @@ def pipe_step(db: DenseBank, c1: BankCtx, key, *, w: int, n_accounts: int,
         hot_lane = (active & (l_ac < hn)).reshape(-1)
         midx = jnp.where(hot_lane, (l_tb * hn + l_ac).reshape(-1), -1)
 
-    first_x = jnp.full((h,), BIG, I32).at[
-        jnp.where(is_x_lane, slot, h)].min(lane, mode="drop")
-    first_s = jnp.full((h,), BIG, I32).at[
-        jnp.where(is_s_lane, slot, h)].min(lane, mode="drop")
-    # held = stamped by the previous step's cohort (released implicitly
-    # one step later; acquire-before-release semantics preserved)
-    if stamp_hot:
-        held_x = pg.hot_gather(db.x_step, db.hot_x, slot, midx, 1,
-                               use_pallas=use_pallas) == t - 1
-        held_s = pg.hot_gather(db.s_step, db.hot_s, slot, midx, 1,
-                               use_pallas=use_pallas) == t - 1
-    elif use_pallas:
-        held_x = pg.gather_rows(db.x_step, slot, 1) == t - 1
-        held_s = pg.gather_rows(db.s_step, slot, 1) == t - 1
-    else:
-        held_x = db.x_step[slot] == t - 1
-        held_s = db.s_step[slot] == t - 1
-    slot_free = ~held_x & ~held_s
-    x_wins = (first_x[slot] < first_s[slot]) & slot_free
-    grant_x = is_x_lane & x_wins & (first_x[slot] == lane)
-    grant_s = is_s_lane & ~held_x & ~x_wins
-    x_step = db.x_step.at[jnp.where(grant_x, slot, h)].set(
-        t, mode="drop", unique_indices=True)
-    # one writer per slot: the first S lane stamps for all sharers
-    s_writer = grant_s & (first_s[slot] == lane)
-    s_step = db.s_step.at[
-        jnp.where(s_writer, slot, h)].set(
-        t, mode="drop", unique_indices=True)
-    hot_x, hot_s = db.hot_x, db.hot_s
-    if stamp_hot:
-        # stamp write-through: the grant masks are one-writer-per-slot, so
-        # their hot subsets are one-writer-per-mirror-index
-        hot_x = hot_x.at[jnp.where(grant_x & (midx >= 0), midx,
-                                   2 * hn)].set(t, mode="drop",
-                                                unique_indices=True)
-        hot_s = hot_s.at[jnp.where(s_writer & (midx >= 0), midx,
-                                   2 * hn)].set(t, mode="drop",
-                                                unique_indices=True)
+    with waves.scope("smallbank_dense", "lock"):
+        first_x = jnp.full((h,), BIG, I32).at[
+            jnp.where(is_x_lane, slot, h)].min(lane, mode="drop")
+        first_s = jnp.full((h,), BIG, I32).at[
+            jnp.where(is_s_lane, slot, h)].min(lane, mode="drop")
+        # held = stamped by the previous step's cohort (released implicitly
+        # one step later; acquire-before-release semantics preserved)
+        if stamp_hot:
+            held_x = pg.hot_gather(db.x_step, db.hot_x, slot, midx, 1,
+                                   use_pallas=use_pallas) == t - 1
+            held_s = pg.hot_gather(db.s_step, db.hot_s, slot, midx, 1,
+                                   use_pallas=use_pallas) == t - 1
+        elif use_pallas:
+            held_x = pg.gather_rows(db.x_step, slot, 1) == t - 1
+            held_s = pg.gather_rows(db.s_step, slot, 1) == t - 1
+        else:
+            held_x = db.x_step[slot] == t - 1
+            held_s = db.s_step[slot] == t - 1
+        slot_free = ~held_x & ~held_s
+        x_wins = (first_x[slot] < first_s[slot]) & slot_free
+        grant_x = is_x_lane & x_wins & (first_x[slot] == lane)
+        grant_s = is_s_lane & ~held_x & ~x_wins
+        x_step = db.x_step.at[jnp.where(grant_x, slot, h)].set(
+            t, mode="drop", unique_indices=True)
+        # one writer per slot: the first S lane stamps for all sharers
+        s_writer = grant_s & (first_s[slot] == lane)
+        s_step = db.s_step.at[
+            jnp.where(s_writer, slot, h)].set(
+            t, mode="drop", unique_indices=True)
+        hot_x, hot_s = db.hot_x, db.hot_s
+        if stamp_hot:
+            # stamp write-through: the grant masks are one-writer-per-slot,
+            # so their hot subsets are one-writer-per-mirror-index
+            hot_x = hot_x.at[jnp.where(grant_x & (midx >= 0), midx,
+                                       2 * hn)].set(t, mode="drop",
+                                                    unique_indices=True)
+            hot_s = hot_s.at[jnp.where(s_writer & (midx >= 0), midx,
+                                       2 * hn)].set(t, mode="drop",
+                                                    unique_indices=True)
 
-    granted = (grant_x | grant_s).reshape(w, L)
-    lock_rejected = (active & ~granted).any(axis=1)
-    alive = ~lock_rejected & (l_op[:, 0] != 0)
+        granted = (grant_x | grant_s).reshape(w, L)
+        lock_rejected = (active & ~granted).any(axis=1)
+        alive = ~lock_rejected & (l_op[:, 0] != 0)
 
     # fused reads from the pre-install table: rows c1 installs below were
     # X-stamped by c1, so this cohort never granted (or consumed) them
-    if use_hotset:
-        raw_bal = pg.hot_gather(db.bal, db.hot_bal, flat_rows, midx, 1,
-                                use_pallas=use_pallas)
-    else:
-        raw_bal = (pg.gather_rows(db.bal, flat_rows, 1) if use_pallas
-                   else db.bal[flat_rows])
-    bal = jnp.where(granted, raw_bal.astype(I32).reshape(w, L), 0)
+    with waves.scope("smallbank_dense", "read"):
+        if use_hotset:
+            raw_bal = pg.hot_gather(db.bal, db.hot_bal, flat_rows, midx, 1,
+                                    use_pallas=use_pallas)
+        else:
+            raw_bal = (pg.gather_rows(db.bal, flat_rows, 1) if use_pallas
+                       else db.bal[flat_rows])
+        bal = jnp.where(granted, raw_bal.astype(I32).reshape(w, L), 0)
 
-    nw, do, logic_abort, commit, committed = compute_phase(
-        ttype, bal, alive, ts_amt)
-    do_write = do & commit[:, None] & active
-    bal_delta = jnp.sum(jnp.where(do_write, nw - bal, 0), dtype=I32)
+    with waves.scope("smallbank_dense", "compute"):
+        nw, do, logic_abort, commit, committed = compute_phase(
+            ttype, bal, alive, ts_amt)
+        do_write = do & commit[:, None] & active
+        bal_delta = jnp.sum(jnp.where(do_write, nw - bal, 0), dtype=I32)
 
     new_ctx = BankCtx(
         rows=rows, do_write=do_write, nw=nw, tbl=l_tb, acc=l_ac,
@@ -378,35 +383,38 @@ def pipe_step(db: DenseBank, c1: BankCtx, key, *, w: int, n_accounts: int,
     # the S/X grants (lock-dominates-write), and the x_step/s_step writes
     # stamp the step scalar — the expiring-lock witness that discharges
     # abort-implies-unlock for this engine's release-free design.
-    dwf = c1.do_write.reshape(-1)
-    wrows = jnp.where(dwf, c1.rows.reshape(-1), oob)       # [wL]
-    newbal = c1.nw.reshape(-1)
-    if use_hotset:
-        # partitioned install: the full table AND the hot mirror take the
-        # write (one fused kernel on the pallas route, a double 1-D
-        # unique-index scatter on XLA) — the write-through that keeps
-        # mirror == table prefix an invariant instead of a protocol
-        w_acc = c1.acc.reshape(-1)
-        w_midx = jnp.where(dwf & (w_acc < hn),
-                           c1.tbl.reshape(-1) * hn + w_acc, -1)
-        bal_new, hot_bal = pg.hot_scatter(
-            db.bal, db.hot_bal, c1.rows.reshape(-1), w_midx, dwf,
-            newbal.astype(U32), 1, use_pallas=use_pallas)
-    else:
-        hot_bal = db.hot_bal
-        bal_new = db.bal.at[wrows].set(newbal.astype(U32), mode="drop",
-                                       unique_indices=True)
+    with waves.scope("smallbank_dense", "install"):
+        dwf = c1.do_write.reshape(-1)
+        wrows = jnp.where(dwf, c1.rows.reshape(-1), oob)       # [wL]
+        newbal = c1.nw.reshape(-1)
+        if use_hotset:
+            # partitioned install: the full table AND the hot mirror take
+            # the write (one fused kernel on the pallas route, a double
+            # 1-D unique-index scatter on XLA) — the write-through that
+            # keeps mirror == table prefix an invariant, not a protocol
+            w_acc = c1.acc.reshape(-1)
+            w_midx = jnp.where(dwf & (w_acc < hn),
+                               c1.tbl.reshape(-1) * hn + w_acc, -1)
+            bal_new, hot_bal = pg.hot_scatter(
+                db.bal, db.hot_bal, c1.rows.reshape(-1), w_midx, dwf,
+                newbal.astype(U32), 1, use_pallas=use_pallas)
+        else:
+            hot_bal = db.hot_bal
+            bal_new = db.bal.at[wrows].set(newbal.astype(U32), mode="drop",
+                                           unique_indices=True)
 
-    newval = jnp.zeros((wrows.shape[0], VW), U32)
-    newval = newval.at[:, 0].set(newbal.astype(U32))
-    newval = newval.at[:, 1].set(jnp.where(dwf, U32(MAGIC), U32(0)))
-    zero = jnp.zeros_like(newbal, U32)
-    # log ver = step index: monotonic per row (one X-writer per row per
-    # step), which is all recovery's max-ver-per-row rule needs
-    stepv = jnp.broadcast_to(t, newbal.shape)
-    logs = logring.append_rep(db.log, dwf, c1.tbl.reshape(-1),
-                              jnp.zeros_like(newbal), zero,
-                              c1.acc.reshape(-1).astype(U32), stepv, newval)
+    with waves.scope("smallbank_dense", "log_append"):
+        newval = jnp.zeros((wrows.shape[0], VW), U32)
+        newval = newval.at[:, 0].set(newbal.astype(U32))
+        newval = newval.at[:, 1].set(jnp.where(dwf, U32(MAGIC), U32(0)))
+        zero = jnp.zeros_like(newbal, U32)
+        # log ver = step index: monotonic per row (one X-writer per row
+        # per step), which is all recovery's max-ver-per-row rule needs
+        stepv = jnp.broadcast_to(t, newbal.shape)
+        logs = logring.append_rep(db.log, dwf, c1.tbl.reshape(-1),
+                                  jnp.zeros_like(newbal), zero,
+                                  c1.acc.reshape(-1).astype(U32), stepv,
+                                  newval)
 
     db = db.replace(bal=bal_new, x_step=x_step, s_step=s_step,
                     step=t + 1, log=logs, hot_bal=hot_bal,

@@ -43,6 +43,7 @@ import jax.numpy as jnp
 
 from ..clients import workloads as wl
 from ..monitor import counters as mon
+from ..monitor import waves
 from . import smallbank
 from .types import Batch, Op, PAD_KEY, Reply
 
@@ -236,10 +237,11 @@ def cohort_step(stacked: smallbank.Shard, key, *, w: int, n_accounts: int,
     appended when the dintmon plane is threaded (``counters``)."""
     step_v = jax.vmap(smallbank.step)
     kgen, kamt = jax.random.split(key)
-    ttype, a1, a2 = gen_cohort(kgen, w, n_accounts)
-    ts_amt = jax.random.randint(kamt, (w,), -TS_AMT_MAX, TS_AMT_MAX + 1,
-                                dtype=I32)
-    l_op, l_tb, l_ac = _lock_slots(ttype, a1, a2)     # [w, L]
+    with waves.scope("smallbank_pipeline", "gen"):
+        ttype, a1, a2 = gen_cohort(kgen, w, n_accounts)
+        ts_amt = jax.random.randint(kamt, (w,), -TS_AMT_MAX,
+                                    TS_AMT_MAX + 1, dtype=I32)
+        l_op, l_tb, l_ac = _lock_slots(ttype, a1, a2)     # [w, L]
     r = w * L
 
     lane_op = l_op.reshape(r)
@@ -254,55 +256,62 @@ def cohort_step(stacked: smallbank.Shard, key, *, w: int, n_accounts: int,
     zver = jnp.zeros((r,), U32)
 
     # ---- wave 1: fused lock+read at owners ---------------------------------
-    op_s = jnp.where((owner[None] == sid[:, None]) & used[None],
-                     lane_op[None], Op.NOP)
-    stacked, rep1 = step_v(stacked, _broadcast_batch(op_s, lane_tbl, lane_key,
-                                                     zval, zver))
-    rt1 = _merge(owner, rep1.rtype).reshape(w, L)
-    rv1 = _merge(owner, rep1.val)                     # [r, VW]
-    rver1 = _merge(owner, rep1.ver).reshape(w, L)
+    with waves.scope("smallbank_pipeline", "wave1"):
+        op_s = jnp.where((owner[None] == sid[:, None]) & used[None],
+                         lane_op[None], Op.NOP)
+        stacked, rep1 = step_v(stacked, _broadcast_batch(
+            op_s, lane_tbl, lane_key, zval, zver))
+        rt1 = _merge(owner, rep1.rtype).reshape(w, L)
+        rv1 = _merge(owner, rep1.val)                     # [r, VW]
+        rver1 = _merge(owner, rep1.ver).reshape(w, L)
 
-    active = l_op != 0
-    granted = active & (rt1 == Reply.GRANT)
-    magic_bad = jnp.sum(granted.reshape(r) & (rv1[:, 1] != MAGIC), dtype=I32)
-    lock_rejected = (active & (rt1 == Reply.REJECT)).any(axis=1)
-    alive = ~lock_rejected
+        active = l_op != 0
+        granted = active & (rt1 == Reply.GRANT)
+        magic_bad = jnp.sum(granted.reshape(r) & (rv1[:, 1] != MAGIC),
+                            dtype=I32)
+        lock_rejected = (active & (rt1 == Reply.REJECT)).any(axis=1)
+        alive = ~lock_rejected
 
-    bal = jnp.where(granted, rv1[:, 0].reshape(w, L).astype(I32), 0)  # [w, L]
+        bal = jnp.where(granted,
+                        rv1[:, 0].reshape(w, L).astype(I32), 0)  # [w, L]
 
-    nw, do, logic_abort, commit, committed = compute_phase(
-        ttype, bal, alive, ts_amt)
-    do_write = do & commit[:, None] & active          # [w, L]
-    bal_delta = jnp.sum(jnp.where(do_write, nw - bal, 0), dtype=I32)
+    with waves.scope("smallbank_pipeline", "compute"):
+        nw, do, logic_abort, commit, committed = compute_phase(
+            ttype, bal, alive, ts_amt)
+        do_write = do & commit[:, None] & active          # [w, L]
+        bal_delta = jnp.sum(jnp.where(do_write, nw - bal, 0), dtype=I32)
 
     # ---- wave 2: log x3 + role (prim/bck) + release ------------------------
-    c_val = jnp.zeros((r, VW), U32)
-    c_val = c_val.at[:, 0].set(nw.reshape(r).astype(U32))
-    c_val = c_val.at[:, 1].set(jnp.where(do_write.reshape(r), U32(MAGIC), U32(0)))
-    c_ver = jnp.where(do_write, rver1 + 1, 0).reshape(r).astype(U32)
-    dwf = do_write.reshape(r)
-    c_key = jnp.where(dwf, lane_acc.astype(U32), _PAD32)
+    with waves.scope("smallbank_pipeline", "wave2"):
+        c_val = jnp.zeros((r, VW), U32)
+        c_val = c_val.at[:, 0].set(nw.reshape(r).astype(U32))
+        c_val = c_val.at[:, 1].set(jnp.where(do_write.reshape(r),
+                                             U32(MAGIC), U32(0)))
+        c_ver = jnp.where(do_write, rver1 + 1, 0).reshape(r).astype(U32)
+        dwf = do_write.reshape(r)
+        c_key = jnp.where(dwf, lane_acc.astype(U32), _PAD32)
 
-    log_op = jnp.where(dwf, Op.COMMIT_LOG, Op.NOP)    # all shards
-    role_s = jnp.where(dwf[None],
-                       jnp.where(owner[None] == sid[:, None],
-                                 Op.COMMIT_PRIM, Op.COMMIT_BCK),
-                       Op.NOP)                         # [S, r]
+        log_op = jnp.where(dwf, Op.COMMIT_LOG, Op.NOP)    # all shards
+        role_s = jnp.where(dwf[None],
+                           jnp.where(owner[None] == sid[:, None],
+                                     Op.COMMIT_PRIM, Op.COMMIT_BCK),
+                           Op.NOP)                         # [S, r]
 
-    relf = granted.reshape(r)
-    rel_op = jnp.where(lane_op == Op.ACQ_X_READ, Op.REL_X, Op.REL_S)
-    rel_s = jnp.where(relf[None] & (owner[None] == sid[:, None]),
-                      rel_op[None], Op.NOP)            # [S, r]
-    rel_key = jnp.where(relf, lane_acc.astype(U32), _PAD32)
+        relf = granted.reshape(r)
+        rel_op = jnp.where(lane_op == Op.ACQ_X_READ, Op.REL_X, Op.REL_S)
+        rel_s = jnp.where(relf[None] & (owner[None] == sid[:, None]),
+                          rel_op[None], Op.NOP)            # [S, r]
+        rel_key = jnp.where(relf, lane_acc.astype(U32), _PAD32)
 
-    lane2_key = jnp.concatenate([c_key, c_key, rel_key])
-    lane2_tbl = jnp.concatenate([lane_tbl, lane_tbl, lane_tbl])
-    lane2_val = jnp.concatenate([c_val, c_val, jnp.zeros((r, VW), U32)])
-    lane2_ver = jnp.concatenate([c_ver, c_ver, jnp.zeros((r,), U32)])
-    op2_s = jnp.concatenate([
-        jnp.broadcast_to(log_op[None], (N_SHARDS, r)), role_s, rel_s], axis=1)
-    stacked, _ = step_v(stacked, _broadcast_batch(
-        op2_s, lane2_tbl, lane2_key, lane2_val, lane2_ver))
+        lane2_key = jnp.concatenate([c_key, c_key, rel_key])
+        lane2_tbl = jnp.concatenate([lane_tbl, lane_tbl, lane_tbl])
+        lane2_val = jnp.concatenate([c_val, c_val, jnp.zeros((r, VW), U32)])
+        lane2_ver = jnp.concatenate([c_ver, c_ver, jnp.zeros((r,), U32)])
+        op2_s = jnp.concatenate([
+            jnp.broadcast_to(log_op[None], (N_SHARDS, r)), role_s, rel_s],
+            axis=1)
+        stacked, _ = step_v(stacked, _broadcast_batch(
+            op2_s, lane2_tbl, lane2_key, lane2_val, lane2_ver))
 
     stats = jnp.stack([
         jnp.asarray(w, I32),

@@ -100,6 +100,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..monitor import counters as mon
+from ..monitor import waves
 from ..ops import pallas_gather as pg
 from ..tables import log as logring
 from . import tatp
@@ -448,57 +449,62 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
     # stay data-dependent on c2.alive — the chain grant -> alive ->
     # ~changed -> wmask is what proves lock-dominates-write and
     # validate-before-install; severing it fails the tier-1 gate.
-    do_write = c2.ws_active & c2.alive[:, None]                 # [w, 2]
-    wmask = do_write.reshape(-1)
-    wkind = c2.ws_kind.reshape(-1)
-    newex = (wkind != 2) & wmask
-    vv = c2.ws_vv.reshape(-1)       # wave-1 meta (ver<<1|exists): the row
-    #                                 was X-held since, so still current
-    meta_new = (((vv >> 1) + 1) << 1) | newex.astype(U32)
-    wrows = jnp.where(wmask, c2.ws_rows.reshape(-1), oob)       # [2w]
-    hn = db.hot_n
-    hot_meta, hot_val = db.hot_meta, db.hot_val
-    payload = jax.random.randint(kv3, (w, 2), 0, 1 << 16, dtype=I32)
-    newval = jnp.zeros((w, 2, val_words), U32)
-    newval = newval.at[:, :, 0].set(payload.astype(U32))
-    newval = newval.at[:, :, 1].set(
-        jnp.where(do_write & (c2.ws_kind != 2), U32(MAGIC), U32(0)))
-    newval = newval.reshape(-1, val_words)
-    newval = jnp.where((wkind == 2)[:, None], U32(0), newval)   # delete zeroes
-    if use_hotset:
-        # partitioned write-through install: the row prefix is the hot
-        # set, so mirror index == row for hot rows (fused kernel on the
-        # pallas route, double 1-D unique-index scatters on XLA)
-        wsr = c2.ws_rows.reshape(-1)
-        w_midx = jnp.where(wmask & (wsr < hn), wsr, -1)
-        meta, hot_meta = pg.hot_scatter(db.meta, hot_meta, wsr, w_midx,
-                                        wmask, meta_new, 1,
-                                        use_pallas=use_pallas)
-        val, hot_val = pg.hot_scatter(db.val, hot_val, wsr, w_midx,
-                                      wmask, newval.reshape(-1),
-                                      val_words, use_pallas=use_pallas)
-    else:
-        meta = db.meta.at[wrows].set(meta_new, mode="drop",
-                                     unique_indices=True)
-        # interleaved-1-D install: row r's words live at [r*VW, (r+1)*VW);
-        # the masked-lane oob row lands at n1*VW >= len and drops (same
-        # discipline as parallel/dense_sharded._apply_backup)
-        wflat = (wrows[:, None] * val_words
-                 + jnp.arange(val_words, dtype=I32)).reshape(-1)
-        val = db.val.at[wflat].set(newval.reshape(-1), mode="drop",
-                                   unique_indices=True)
+    with waves.scope("tatp_dense", "install"):
+        do_write = c2.ws_active & c2.alive[:, None]             # [w, 2]
+        wmask = do_write.reshape(-1)
+        wkind = c2.ws_kind.reshape(-1)
+        newex = (wkind != 2) & wmask
+        vv = c2.ws_vv.reshape(-1)   # wave-1 meta (ver<<1|exists): the row
+        #                             was X-held since, so still current
+        meta_new = (((vv >> 1) + 1) << 1) | newex.astype(U32)
+        wrows = jnp.where(wmask, c2.ws_rows.reshape(-1), oob)   # [2w]
+        hn = db.hot_n
+        hot_meta, hot_val = db.hot_meta, db.hot_val
+        payload = jax.random.randint(kv3, (w, 2), 0, 1 << 16, dtype=I32)
+        newval = jnp.zeros((w, 2, val_words), U32)
+        newval = newval.at[:, :, 0].set(payload.astype(U32))
+        newval = newval.at[:, :, 1].set(
+            jnp.where(do_write & (c2.ws_kind != 2), U32(MAGIC), U32(0)))
+        newval = newval.reshape(-1, val_words)
+        newval = jnp.where((wkind == 2)[:, None], U32(0),
+                           newval)                      # delete zeroes
+        if use_hotset:
+            # partitioned write-through install: the row prefix is the hot
+            # set, so mirror index == row for hot rows (fused kernel on the
+            # pallas route, double 1-D unique-index scatters on XLA)
+            wsr = c2.ws_rows.reshape(-1)
+            w_midx = jnp.where(wmask & (wsr < hn), wsr, -1)
+            meta, hot_meta = pg.hot_scatter(db.meta, hot_meta, wsr, w_midx,
+                                            wmask, meta_new, 1,
+                                            use_pallas=use_pallas)
+            val, hot_val = pg.hot_scatter(db.val, hot_val, wsr, w_midx,
+                                          wmask, newval.reshape(-1),
+                                          val_words, use_pallas=use_pallas)
+        else:
+            meta = db.meta.at[wrows].set(meta_new, mode="drop",
+                                         unique_indices=True)
+            # interleaved-1-D install: row r's words live at
+            # [r*VW, (r+1)*VW); the masked-lane oob row lands at
+            # n1*VW >= len and drops (same discipline as
+            # parallel/dense_sharded._apply_backup)
+            wflat = (wrows[:, None] * val_words
+                     + jnp.arange(val_words, dtype=I32)).reshape(-1)
+            val = db.val.at[wflat].set(newval.reshape(-1), mode="drop",
+                                       unique_indices=True)
 
-    newver = (vv >> 1) + 1
-    flags_del = (wkind == 2).astype(I32)
-    log_tbl = c2.ws_tbl.reshape(-1)
-    log_key = c2.ws_key.reshape(-1).astype(U32)
-    zero_hi = jnp.zeros_like(log_key)
-    logs = logring.append_rep(db.log, wmask, log_tbl, flags_del, zero_hi,
-                              log_key, newver, newval)
+    with waves.scope("tatp_dense", "log_append"):
+        newver = (vv >> 1) + 1
+        flags_del = (wkind == 2).astype(I32)
+        log_tbl = c2.ws_tbl.reshape(-1)
+        log_key = c2.ws_key.reshape(-1).astype(U32)
+        zero_hi = jnp.zeros_like(log_key)
+        logs = logring.append_rep(db.log, wmask, log_tbl, flags_del,
+                                  zero_hi, log_key, newver, newval)
 
     # ---- wave 1: new cohort read + lock -----------------------------------
     if gen_new:
-        ttype, ops, tbl, kk, ws = gen_cohort(kg, w, n_sub, mix=mix)
+        with waves.scope("tatp_dense", "gen"):
+            ttype, ops, tbl, kk, ws = gen_cohort(kg, w, n_sub, mix=mix)
         ws_active, ws_lane, ws_tbl, ws_key, ws_kind = ws
     else:
         ttype = jnp.zeros((w,), I32)
@@ -521,15 +527,16 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
     # overlap their DMAs (PERF.md round-3 finding 3) — the fusion still
     # halves per-op launch/descriptor overhead on ops measured at
     # 0.6-0.9 ms per 16-32k random indices
-    gidx = jnp.concatenate([c1.rows.reshape(-1), rows.reshape(-1)])
-    if use_hotset:
-        g_midx = jnp.where(gidx < hn, gidx, -1)
-        g = pg.hot_gather(meta, hot_meta, gidx, g_midx, 1,
-                          use_pallas=use_pallas)
-    else:
-        g = pg.gather_rows(meta, gidx, 1) if use_pallas else meta[gidx]
-    vvB = g[: w * K].reshape(w, K)                              # [w, K]
-    rmeta = g[w * K:].reshape(w, K)                             # [w, K]
+    with waves.scope("tatp_dense", "meta_gather"):
+        gidx = jnp.concatenate([c1.rows.reshape(-1), rows.reshape(-1)])
+        if use_hotset:
+            g_midx = jnp.where(gidx < hn, gidx, -1)
+            g = pg.hot_gather(meta, hot_meta, gidx, g_midx, 1,
+                              use_pallas=use_pallas)
+        else:
+            g = pg.gather_rows(meta, gidx, 1) if use_pallas else meta[gidx]
+        vvB = g[: w * K].reshape(w, K)                          # [w, K]
+        rmeta = g[w * K:].reshape(w, K)                         # [w, K]
 
     # ---- wave 2 of c1: validate read-set version compare ------------------
     bad = c1.is_read & (vvB != c1.vv1)
@@ -551,17 +558,19 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
         # the 6.2 GB val array per step; check_magic=False is an A/B
         # measurement knob (DINT_BENCH_CHECK_MAGIC=0) quantifying it —
         # the default keeps the reference's every-read integrity check
-        midx = (rows * val_words + 1).reshape(-1)
-        if use_hotset:
-            # the mirror is the flat word prefix [0, hn*VW): a hot row's
-            # magic word sits at the same flat offset in it
-            mg_midx = jnp.where((rows < hn).reshape(-1), midx, -1)
-            rmagic = pg.hot_gather(val, hot_val, midx, mg_midx, 1,
-                                   use_pallas=use_pallas).reshape(w, K)
-        else:
-            rmagic = (pg.gather_rows(val, midx, 1).reshape(w, K)
-                      if use_pallas else val[midx].reshape(w, K))
-        magic_bad = jnp.sum(is_read & rex & (rmagic != MAGIC), dtype=I32)
+        with waves.scope("tatp_dense", "magic_gather"):
+            midx = (rows * val_words + 1).reshape(-1)
+            if use_hotset:
+                # the mirror is the flat word prefix [0, hn*VW): a hot
+                # row's magic word sits at the same flat offset in it
+                mg_midx = jnp.where((rows < hn).reshape(-1), midx, -1)
+                rmagic = pg.hot_gather(val, hot_val, midx, mg_midx, 1,
+                                       use_pallas=use_pallas).reshape(w, K)
+            else:
+                rmagic = (pg.gather_rows(val, midx, 1).reshape(w, K)
+                          if use_pallas else val[midx].reshape(w, K))
+            magic_bad = jnp.sum(is_read & rex & (rmagic != MAGIC),
+                                dtype=I32)
     else:
         magic_bad = jnp.asarray(0, I32)
 
@@ -573,37 +582,40 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
     # (t-2) expired this step, matching the wave-3 release timing above.
     # Candidates for held rows are masked OUT of the scatter so rejected
     # attempts cannot keep a hot row stamped (no livelock).
-    ws_rows = jnp.where(ws_active, base[ws_tbl] + ws_key, sent)  # [w, 2]
-    ws_vv = jnp.take_along_axis(rmeta, ws_lane, axis=1)
-    flat_ws = ws_rows.reshape(-1)
-    active = ws_active.reshape(-1)
-    if use_pallas:
-        if counters is not None:
-            # the fused kernel only exposes winners; the won-vs-lost split
-            # needs the pre-arbitration stamps, read BEFORE the kernel
-            # aliases arb in place (a read-before-donate, which the
-            # dintlint aliasing pass permits; bit-identical to the XLA
-            # path's arb_old gather)
-            held = ((pg.gather_rows(db.arb, flat_ws, 1) >> K_ARB)
-                    == (t - 1))
-        # fused kernel pass: gather + stamp compare + first-lane-wins
-        # scatter-max + winner read-back in ONE launch, arb updated in
-        # place (bit-identical to the XLA chain below — pinned in
-        # tests/test_pallas_ops.py)
-        # hot_n > 0 caches the arb prefix in VMEM for the pass (dintcache);
-        # outputs bit-identical either way
-        arb, grant_u = pg.lock_arbitrate(db.arb, flat_ws, active, t, K_ARB,
-                                         hot_n=hn if use_hotset else 0)
-        grant = (grant_u != 0).reshape(w, 2)
-    else:
-        arb_old = db.arb[flat_ws]   # [2w]; sentinel row is never stamped
-        held = (arb_old >> K_ARB) == (t - 1)
-        inv_slot = U32(2 * w - 1) - jnp.arange(2 * w, dtype=U32)
-        packed = (t << K_ARB) | inv_slot
-        cand = active & ~held
-        arb = db.arb.at[jnp.where(cand, flat_ws, oob)].max(packed,
-                                                           mode="drop")
-        grant = (cand & (arb[flat_ws] == packed)).reshape(w, 2)
+    with waves.scope("tatp_dense", "lock"):
+        ws_rows = jnp.where(ws_active, base[ws_tbl] + ws_key,
+                            sent)                               # [w, 2]
+        ws_vv = jnp.take_along_axis(rmeta, ws_lane, axis=1)
+        flat_ws = ws_rows.reshape(-1)
+        active = ws_active.reshape(-1)
+        if use_pallas:
+            if counters is not None:
+                # the fused kernel only exposes winners; the won-vs-lost
+                # split needs the pre-arbitration stamps, read BEFORE the
+                # kernel aliases arb in place (a read-before-donate, which
+                # the dintlint aliasing pass permits; bit-identical to the
+                # XLA path's arb_old gather)
+                held = ((pg.gather_rows(db.arb, flat_ws, 1) >> K_ARB)
+                        == (t - 1))
+            # fused kernel pass: gather + stamp compare + first-lane-wins
+            # scatter-max + winner read-back in ONE launch, arb updated in
+            # place (bit-identical to the XLA chain below — pinned in
+            # tests/test_pallas_ops.py)
+            # hot_n > 0 caches the arb prefix in VMEM for the pass
+            # (dintcache); outputs bit-identical either way
+            arb, grant_u = pg.lock_arbitrate(db.arb, flat_ws, active, t,
+                                             K_ARB,
+                                             hot_n=hn if use_hotset else 0)
+            grant = (grant_u != 0).reshape(w, 2)
+        else:
+            arb_old = db.arb[flat_ws]   # [2w]; sentinel never stamped
+            held = (arb_old >> K_ARB) == (t - 1)
+            inv_slot = U32(2 * w - 1) - jnp.arange(2 * w, dtype=U32)
+            packed = (t << K_ARB) | inv_slot
+            cand = active & ~held
+            arb = db.arb.at[jnp.where(cand, flat_ws, oob)].max(packed,
+                                                               mode="drop")
+            grant = (cand & (arb[flat_ws] == packed)).reshape(w, 2)
 
     # reply types: reads from the gather; write-slot GRANT/REJECT direct
     rt = jnp.where(is_read & used,
@@ -692,15 +704,16 @@ def rebase_stamps(db: DenseDB) -> DenseDB:
     live stamps (step-1 -> 2, step-2 -> 1) are kept, everything older is
     zeroed, and the step counter restarts at 3. One full elementwise pass,
     run once per ~16k steps."""
-    t = db.step
-    ts = db.arb >> K_ARB
-    keep = ts + 2 >= t
-    new_ts = jnp.where(keep, ts - (t - 3), 0)
-    arb = jnp.where(keep, (new_ts << K_ARB)
-                    | (db.arb & U32((1 << K_ARB) - 1)), U32(0))
-    # t*0+3 (not a fresh constant) so the step keeps its varying-axis type
-    # under shard_map's lax.cond (dense_sharded.block_local)
-    return db.replace(arb=arb, step=t * U32(0) + U32(3))
+    with waves.scope("tatp_dense", "rebase"):
+        t = db.step
+        ts = db.arb >> K_ARB
+        keep = ts + 2 >= t
+        new_ts = jnp.where(keep, ts - (t - 3), 0)
+        arb = jnp.where(keep, (new_ts << K_ARB)
+                        | (db.arb & U32((1 << K_ARB) - 1)), U32(0))
+        # t*0+3 (not a fresh constant) so the step keeps its varying-axis
+        # type under shard_map's lax.cond (dense_sharded.block_local)
+        return db.replace(arb=arb, step=t * U32(0) + U32(3))
 
 
 def build_pipelined_runner(n_sub: int, w: int = 8192, val_words: int = 10,

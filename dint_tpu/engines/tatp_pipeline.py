@@ -43,6 +43,7 @@ import jax.numpy as jnp
 
 from ..clients import workloads as wl
 from ..monitor import counters as mon
+from ..monitor import waves
 from . import tatp
 from .types import Batch, Op, PAD_KEY, Reply
 
@@ -510,7 +511,8 @@ def pipe_step(stacked: tatp.Shard, c1: PipeCtx, c2: PipeCtx, key, *, w: int,
 
     # ---- assemble the combined batch [12w lanes] ---------------------------
     if gen_new:
-        ttype, ops, tbl, kk, ws = gen_cohort(kg, w, n_sub, mix=mix)
+        with waves.scope("tatp_pipeline", "gen"):
+            ttype, ops, tbl, kk, ws = gen_cohort(kg, w, n_sub, mix=mix)
         ws_active, ws_lane, ws_tbl, ws_key, ws_kind = ws
     else:
         e = empty_ctx(w)
@@ -518,62 +520,68 @@ def pipe_step(stacked: tatp.Shard, c1: PipeCtx, c2: PipeCtx, key, *, w: int,
         ops, tbl, kk = e.ops, e.tbl, e.kk
         ws_active, ws_lane = e.ws_active, jnp.zeros((w, 2), I32)
         ws_tbl, ws_key, ws_kind = e.ws_tbl, e.ws_key, e.ws_kind
-    a_op, a_tbl, a_key, a_owner, a_used = _wave1_lanes(ops, tbl, kk)
-    opA_s = jnp.where((a_owner[None] == sid[:, None]) & a_used[None],
-                      a_op[None], Op.NOP)
+    with waves.scope("tatp_pipeline", "assemble"):
+        a_op, a_tbl, a_key, a_owner, a_used = _wave1_lanes(ops, tbl, kk)
+        opA_s = jnp.where((a_owner[None] == sid[:, None]) & a_used[None],
+                          a_op[None], Op.NOP)
 
-    b_op, b_tbl, b_key, b_owner, b_used, is_read_lane = _validate_lanes(c1)
-    opB_s = jnp.where((b_owner[None] == sid[:, None]) & b_used[None],
-                      b_op[None], Op.NOP)
+        b_op, b_tbl, b_key, b_owner, b_used, is_read_lane = \
+            _validate_lanes(c1)
+        opB_s = jnp.where((b_owner[None] == sid[:, None]) & b_used[None],
+                          b_op[None], Op.NOP)
 
-    opC_s, c_tbl, c_key, c_val = _wave3_lanes(c2, kv3, val_words)
+        opC_s, c_tbl, c_key, c_val = _wave3_lanes(c2, kv3, val_words)
 
-    zvalAB = jnp.zeros((2 * r, val_words), U32)
-    lane_tbl = jnp.concatenate([a_tbl, b_tbl, c_tbl])
-    lane_key = jnp.concatenate([a_key, b_key, c_key])
-    lane_val = jnp.concatenate([zvalAB, c_val])
-    op_s = jnp.concatenate([opA_s, opB_s, opC_s], axis=1)
-    zver = jnp.zeros((lane_key.shape[0],), U32)
+        zvalAB = jnp.zeros((2 * r, val_words), U32)
+        lane_tbl = jnp.concatenate([a_tbl, b_tbl, c_tbl])
+        lane_key = jnp.concatenate([a_key, b_key, c_key])
+        lane_val = jnp.concatenate([zvalAB, c_val])
+        op_s = jnp.concatenate([opA_s, opB_s, opC_s], axis=1)
+        zver = jnp.zeros((lane_key.shape[0],), U32)
 
-    stacked, rep = step_v(stacked, _broadcast_batch(op_s, lane_tbl, lane_key,
-                                                    lane_val, zver))
+    with waves.scope("tatp_pipeline", "engine_step"):
+        stacked, rep = step_v(stacked, _broadcast_batch(
+            op_s, lane_tbl, lane_key, lane_val, zver))
 
     # ---- wave-1 outcome for the new cohort --------------------------------
-    rtA = _merge(a_owner, rep.rtype[:, :r]).reshape(w, K)
-    rvA = _merge(a_owner, rep.val[:, :r])
-    rverA = _merge(a_owner, rep.ver[:, :r]).reshape(w, K)
-    is_val_lane = rtA.reshape(r) == Reply.VAL
-    magic_bad = jnp.sum(is_val_lane & (rvA[:, 1] != MAGIC), dtype=I32)
+    with waves.scope("tatp_pipeline", "classify"):
+        rtA = _merge(a_owner, rep.rtype[:, :r]).reshape(w, K)
+        rvA = _merge(a_owner, rep.val[:, :r])
+        rverA = _merge(a_owner, rep.ver[:, :r]).reshape(w, K)
+        is_val_lane = rtA.reshape(r) == Reply.VAL
+        magic_bad = jnp.sum(is_val_lane & (rvA[:, 1] != MAGIC), dtype=I32)
 
-    is_ro, rw, granted, lock_rejected, missing = classify_wave1(
-        ttype, rtA, ops, ws_active, ws_lane)
+        is_ro, rw, granted, lock_rejected, missing = classify_wave1(
+            ttype, rtA, ops, ws_active, ws_lane)
 
-    new_ctx = PipeCtx(
-        ops=ops, tbl=tbl, kk=kk, rver1=rverA, rt1_val=(rtA == Reply.VAL),
-        granted=granted, alive=rw & ~lock_rejected & ~missing,
-        ro_commit=is_ro & ~missing,
-        ws_active=ws_active, ws_tbl=ws_tbl, ws_key=ws_key, ws_kind=ws_kind,
-        attempted=jnp.asarray(w if gen_new else 0, I32),
-        ab_lock=(rw & lock_rejected).sum(dtype=I32),
-        ab_missing=((rw & ~lock_rejected & missing)
-                    | (is_ro & missing)).sum(dtype=I32),
-        ab_validate=jnp.asarray(0, I32),
-        magic_bad=magic_bad)
+        new_ctx = PipeCtx(
+            ops=ops, tbl=tbl, kk=kk, rver1=rverA,
+            rt1_val=(rtA == Reply.VAL),
+            granted=granted, alive=rw & ~lock_rejected & ~missing,
+            ro_commit=is_ro & ~missing,
+            ws_active=ws_active, ws_tbl=ws_tbl, ws_key=ws_key,
+            ws_kind=ws_kind,
+            attempted=jnp.asarray(w if gen_new else 0, I32),
+            ab_lock=(rw & lock_rejected).sum(dtype=I32),
+            ab_missing=((rw & ~lock_rejected & missing)
+                        | (is_ro & missing)).sum(dtype=I32),
+            ab_validate=jnp.asarray(0, I32),
+            magic_bad=magic_bad)
 
-    # ---- validate outcome for c1 ------------------------------------------
-    rtB = _merge(b_owner, rep.rtype[:, r:2 * r]).reshape(w, K)
-    rverB = _merge(b_owner, rep.ver[:, r:2 * r]).reshape(w, K)
-    bad_lane = is_read_lane & ((rverB != c1.rver1)
-                               | ((rtB != Reply.VAL) & c1.rt1_val))
-    changed = bad_lane.any(axis=1)
-    c1 = c1.replace(alive=c1.alive & ~changed,
-                    ab_validate=(c1.alive & changed).sum(dtype=I32))
+        # ---- validate outcome for c1 --------------------------------------
+        rtB = _merge(b_owner, rep.rtype[:, r:2 * r]).reshape(w, K)
+        rverB = _merge(b_owner, rep.ver[:, r:2 * r]).reshape(w, K)
+        bad_lane = is_read_lane & ((rverB != c1.rver1)
+                                   | ((rtB != Reply.VAL) & c1.rt1_val))
+        changed = bad_lane.any(axis=1)
+        c1 = c1.replace(alive=c1.alive & ~changed,
+                        ab_validate=(c1.alive & changed).sum(dtype=I32))
 
-    # ---- c2 completed: emit its stats -------------------------------------
-    stats = jnp.stack([
-        c2.attempted,
-        (c2.ro_commit | c2.alive).sum(dtype=I32),
-        c2.ab_lock, c2.ab_missing, c2.ab_validate, c2.magic_bad])
+        # ---- c2 completed: emit its stats ---------------------------------
+        stats = jnp.stack([
+            c2.attempted,
+            (c2.ro_commit | c2.alive).sum(dtype=I32),
+            c2.ab_lock, c2.ab_missing, c2.ab_validate, c2.magic_bad])
     if counters is not None:
         dw2 = c2.ws_active & c2.alive[:, None]   # == _wave3_lanes do_write
         counters = mon.bump(counters, {

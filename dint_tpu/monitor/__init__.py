@@ -20,10 +20,18 @@ This package is the TPU re-expression of those per-CPU counter maps:
   Chrome-trace export, and the `jax.profiler` session hook used by
   bench.py / exp.py.
 
+* `waves` / `attrib` — dintscope, the TIMING half (round 11): the
+  append-only wave-name registry behind the engines'
+  `jax.named_scope("dint.<engine>.<wave>")` annotations, and the
+  attribution that turns a `jax.profiler` trace (+ the JSONL stream)
+  into a per-wave time breakdown. `tools/dintscope.py` is its CLI and
+  `diff` its perf-regression gate.
+
 Monitoring is OFF by default and adds nothing to the traced step when off
 (the builders thread no counter state and engine outputs stay
-bit-identical). `tools/dintmon.py` is the CLI; OBSERVABILITY.md documents
-the registry, the event schema, and the dintlint interaction.
+bit-identical; the named scopes add no jaxpr equations either way).
+`tools/dintmon.py` is the CLI; OBSERVABILITY.md documents the registry,
+the event schema, and the dintlint interaction.
 """
 from __future__ import annotations
 
@@ -45,3 +53,6 @@ from .counters import (CTR_STEPS, CTR_TXN_ATTEMPTED,  # noqa: F401
                        CTR_HOT_REFRESH_BYTES)
 from .trace import (Monitor, TraceWriter, export_chrome_trace,  # noqa: F401
                     profiler_session, read_events)
+# dintscope (the timing half): wave registry + trace attribution — import
+# as modules so the counter namespace above stays flat and unambiguous
+from . import attrib, waves  # noqa: F401, E402

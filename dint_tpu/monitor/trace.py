@@ -77,7 +77,20 @@ class Monitor:
 
     The fetch (np.asarray of the ~100-byte buf) is the only device
     traffic and happens at the caller's cadence — per block in bench.py,
-    never inside jit."""
+    never inside jit.
+
+    ``defer=True`` double-buffers that fetch: the buffer is copied
+    on-device into a fresh (never-donated) array and only MATERIALIZED on
+    the next observe/flush call — i.e. block i-1's host fetch happens
+    after block i has been dispatched, so the JSONL drain no longer
+    serializes the dispatch stream (it used to cost a full
+    dispatch->fetch sync per block; the round-8 "never enable for
+    headline numbers" caveat is downgraded accordingly in
+    OBSERVABILITY.md). The on-device copy is mandatory: the carry's own
+    counter leaf is DONATED into the next dispatch, so a deferred read of
+    it would hit a deleted buffer. Deltas are bit-identical to the
+    synchronous path (pinned in tests/test_dintmon.py) — only WHEN the
+    bytes cross to the host changes."""
 
     def __init__(self, writer: TraceWriter | None = None):
         self.writer = writer
@@ -85,12 +98,47 @@ class Monitor:
         self.totals: dict[str, int] = ctr.zeros_dict()
         self._t0 = time.monotonic()
         self._step = 0
+        self._pending = None    # (device buf copy, batch, dur_s, t)
 
-    def observe(self, counters, *, batch: int = 0,
-                dur_s: float = 0.0) -> dict[str, int]:
+    def observe(self, counters, *, batch: int = 0, dur_s: float = 0.0,
+                defer: bool = False) -> dict[str, int] | None:
         """counters: a Counters pytree / raw buf / stacked per-device buf
-        (the last element of a monitored runner's carry). Returns this
-        window's delta dict."""
+        (the last element of a monitored runner's carry). Returns the
+        completed window's delta dict — this window's in synchronous
+        mode, the PREVIOUS window's under ``defer`` (None when nothing
+        was pending yet; call :meth:`flush` after the loop to land the
+        final window)."""
+        out = None
+        if self._pending is not None:
+            out = self._process(*self._pending)
+            self._pending = None
+        if defer:
+            import jax.numpy as jnp
+
+            buf = counters.buf if isinstance(counters, ctr.Counters) \
+                else counters
+            snap = jnp.asarray(buf) + jnp.uint32(0)   # fresh, undonated
+            try:
+                snap.copy_to_host_async()
+            except Exception:       # noqa: BLE001 — best-effort prefetch
+                pass
+            self._pending = (snap, batch, dur_s,
+                             time.monotonic() - self._t0)
+            return out
+        d = self._process(counters, batch, dur_s,
+                          time.monotonic() - self._t0)
+        return d if out is None else d
+
+    def flush(self) -> dict[str, int] | None:
+        """Materialize a deferred window, if any (call once after the
+        dispatch loop, before draining the runner)."""
+        if self._pending is None:
+            return None
+        out = self._process(*self._pending)
+        self._pending = None
+        return out
+
+    def _process(self, counters, batch, dur_s, t) -> dict[str, int]:
         snap = ctr.snapshot(counters)
         d = ctr.delta(snap, self.prev)
         self.prev = snap
@@ -100,9 +148,8 @@ class Monitor:
             else:
                 self.totals[name] += d[name]
         if self.writer is not None:
-            self.writer.wave(step=self._step,
-                             t=time.monotonic() - self._t0,
-                             dur_s=dur_s, batch=batch, counters=d)
+            self.writer.wave(step=self._step, t=t, dur_s=dur_s,
+                             batch=batch, counters=d)
         self._step += 1
         return d
 
@@ -173,30 +220,55 @@ def summarize_events(meta: dict, waves: list[dict]) -> dict:
 def export_chrome_trace(events_path: str, out_path: str,
                         counter_tracks: tuple[str, ...] = (
                             "txn_committed", "ab_lock", "ab_validate",
-                            "ring_hwm")) -> int:
+                            "ring_hwm"),
+                        merge_trace: str | None = None,
+                        offset_us: float | None = None) -> int:
     """Convert a wave-event stream to the Chrome trace-event JSON format:
     one complete ("X") slice per wave on a single row + "C" counter
     tracks for the headline counters. Returns the number of trace events
-    written. Load in chrome://tracing or https://ui.perfetto.dev."""
+    written. Load in chrome://tracing or https://ui.perfetto.dev.
+
+    ``merge_trace``: a `jax.profiler` Chrome trace (file or trace dir) to
+    merge into the same timeline, so the dintmon wave slices and the
+    device ops land in ONE Perfetto view. The two clocks are aligned on a
+    shared offset: by default the FIRST wave event is pinned to the
+    profiler trace's earliest timestamp (both streams start when the
+    instrumented region starts); pass ``offset_us`` to override with an
+    explicit dintmon->profiler clock offset. The wave stream keeps its
+    own pid row so slices never interleave with device ops."""
     meta, waves = read_events(events_path)
-    events = [{"name": "process_name", "ph": "M", "pid": 0,
+    merged = []
+    shift_us = 0.0
+    if merge_trace is not None:
+        from . import attrib
+
+        merged, _src = attrib.load_trace_events(merge_trace)
+        ts0 = min((float(e["ts"]) for e in merged
+                   if e.get("ph") == "X" and "ts" in e), default=0.0)
+        if offset_us is not None:
+            shift_us = float(offset_us)
+        elif waves:
+            shift_us = ts0 - float(waves[0]["t"]) * 1e6
+    pid = 1000 if merge_trace is not None else 0
+    events = [{"name": "process_name", "ph": "M", "pid": pid,
                "args": {"name": meta.get("name", "dintmon")}}]
     for w in waves:
-        ts = float(w["t"]) * 1e6
+        ts = float(w["t"]) * 1e6 + shift_us
         dur = max(float(w.get("dur_s") or 0.0) * 1e6, 1.0)
         args = {"batch": w.get("batch", 0)}
         c = w.get("counters")
         if c:
             args.update({k: c[k] for k in counter_tracks if k in c})
-        events.append({"name": f"wave {w['step']}", "ph": "X", "pid": 0,
+        events.append({"name": f"wave {w['step']}", "ph": "X", "pid": pid,
                        "tid": 0, "ts": round(ts, 3), "dur": round(dur, 3),
                        "args": args})
         if c:
             for track in counter_tracks:
                 if track in c:
-                    events.append({"name": track, "ph": "C", "pid": 0,
+                    events.append({"name": track, "ph": "C", "pid": pid,
                                    "ts": round(ts, 3),
                                    "args": {track: int(c[track])}})
+    events.extend(merged)
     with open(out_path, "w") as f:
         json.dump({"traceEvents": events,
                    "displayTimeUnit": "ms"}, f)

@@ -1,0 +1,199 @@
+"""dintscope wave-name registry: the timing half's schema.
+
+dintmon made the engines auditable by COUNT; this registry is the anchor
+for auditing them by TIME. Every wave of every hot path is wrapped in a
+``jax.named_scope("dint.<engine>.<wave>")`` annotation (the `scope`
+helper below), so the wave identity survives jit into XLA op metadata and
+shows up verbatim in `jax.profiler` Chrome/Perfetto traces —
+`monitor/attrib.py` then attributes device time back to these names and
+`tools/dintscope.py diff` gates regressions per wave. The reference gets
+the same attribution for free from per-program eBPF counters and perf
+annotations on named kernels; on TPU the name stack is the only identity
+that survives fusion, so it is schema:
+
+* **Append-only.** Wave names are keyed on by breakdown artifacts, the
+  regression gate's thresholds, and the checked-in trace fixture —
+  renaming or removing one silently un-gates it. Add waves by appending a
+  row here and wrapping the new code region (recipe in OBSERVABILITY.md);
+  regenerate the fixture with `python tools/dintscope.py synth`.
+* **Semantics-neutral.** `jax.named_scope` only pushes the name stack —
+  it adds no jaxpr equations, so engine outputs are bit-identical with
+  scopes on or off (pinned in tests/test_dintscope.py) and the
+  dintlint/dintproof target matrix is unaffected. `DINT_SCOPE=0` disables
+  the annotations entirely (the A/B knob behind that pin).
+* **Bytes formulas are declared, not measured.** Each wave may carry an
+  expected-bytes-per-step formula (a string evaluated against the run's
+  geometry: w, k, l, vw, d, ...), the same hand accounting PERF.md's
+  closing ledger was built from — attribution divides measured time into
+  it to report effective HBM bandwidth per wave, which is how "this wave
+  is dispatch-bound, not bandwidth-bound" becomes machine-readable.
+  Formulas are estimates of logical bytes moved (random-access row
+  traffic; they ignore XLA padding/tiling) and `None` marks compute-only
+  waves.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+
+PREFIX = "dint"
+
+# ------------------------------------------------------------ the registry
+# (engine, wave, doc, bytes-per-step formula | None). APPEND ONLY.
+# Formula variables: w = cohort width, k = TATP wave-1 lanes per txn,
+# l = SmallBank lock lanes per txn, vw = val words, d = mesh devices.
+# Log-entry estimate: ~20 B header + 4*vw payload, x3 replicas.
+_REGISTRY: tuple[tuple[str, str, str, str | None], ...] = (
+    # --- dense TATP (engines/tatp_dense.py): 3-wave fused step ---------
+    ("tatp_dense", "gen",
+     "on-device cohort generation (txn mix, NURand, lane layout) — "
+     "compute-only", None),
+    ("tatp_dense", "install",
+     "wave-3 install: meta + interleaved-val scatters of cohort t-2's "
+     "certified writes (2w write slots)", "2*w*(4 + 4*vw)"),
+    ("tatp_dense", "log_append",
+     "log x3 append of cohort t-2's installs (RepLog packed entries)",
+     "2*w*3*(20 + 4*vw)"),
+    ("tatp_dense", "meta_gather",
+     "fused meta gather serving c1's validate re-read AND the new "
+     "cohort's reads (2wK random lanes over the meta array)",
+     "2*w*k*4"),
+    ("tatp_dense", "magic_gather",
+     "magic-word integrity gather over the val array (wK random "
+     "single-word lanes; absent when check_magic=False)", "w*k*4"),
+    ("tatp_dense", "lock",
+     "lock arbitration on the arb array: stamp gather + masked "
+     "scatter-max + winner gather-back (2w write slots; ONE fused kernel "
+     "pass on the pallas route)", "3*2*w*4"),
+    ("tatp_dense", "rebase",
+     "arb stamp rebase (full elementwise pass, once per ~16k steps — "
+     "amortizes to noise)", None),
+    # --- dense SmallBank (engines/smallbank_dense.py): 2-wave step -----
+    ("smallbank_dense", "gen",
+     "on-device cohort generation (mix + hot-set skew) — compute-only",
+     None),
+    ("smallbank_dense", "lock",
+     "no-wait S/X arbitration: held-stamp gathers + per-slot "
+     "scatter-mins + grant stamp installs (wL lanes)", "5*w*l*4"),
+    ("smallbank_dense", "read",
+     "fused balance gather (wL random single-word lanes)", "w*l*4"),
+    ("smallbank_dense", "compute",
+     "shared per-txn balance logic (compute_phase) — compute-only", None),
+    ("smallbank_dense", "install",
+     "wave-2 balance install scatter of cohort t-1 (wL rows, plus the "
+     "hot-mirror write-through when the dintcache tier is on)",
+     "w*l*4"),
+    ("smallbank_dense", "log_append",
+     "log x3 append of cohort t-1's installs", "w*l*3*(20 + 4*vw)"),
+    # --- generic TATP pipeline (engines/tatp_pipeline.py) --------------
+    ("tatp_pipeline", "gen",
+     "cohort generation (shared gen_cohort) — compute-only", None),
+    ("tatp_pipeline", "assemble",
+     "combined 12w-lane batch assembly (wave-1 + validate + wave-3 "
+     "slices) — compute-only", None),
+    ("tatp_pipeline", "engine_step",
+     "vmapped sort-based engine step over the 3 stacked shard replicas "
+     "(the sorts + segmented reductions + table ops)", None),
+    ("tatp_pipeline", "classify",
+     "per-wave outcome classification + stats emission — compute-only",
+     None),
+    # --- generic SmallBank pipeline (engines/smallbank_pipeline.py) ----
+    ("smallbank_pipeline", "gen",
+     "cohort generation + lock-slot layout — compute-only", None),
+    ("smallbank_pipeline", "wave1",
+     "fused lock+read at owners: vmapped engine step over the 3 stacked "
+     "replicas", None),
+    ("smallbank_pipeline", "compute",
+     "shared per-txn balance logic (compute_phase) — compute-only", None),
+    ("smallbank_pipeline", "wave2",
+     "log x3 + prim/bck install + release: second vmapped engine step",
+     None),
+    # --- multi-chip dense TATP (parallel/dense_sharded.py); the local
+    # --- step re-uses the tatp_dense wave scopes ------------------------
+    ("dense_sharded", "replicate",
+     "CommitBck x2 + CommitLog fan-out: ppermute the install record to "
+     "devices +1/+2 and apply to backup tables + local logs (2 hops x "
+     "2w records of meta+val plus a log append each)",
+     "2*(2*w*(4 + 4*vw) + 2*w*(20 + 4*vw))"),
+    # --- multi-chip dense SmallBank (parallel/dense_sharded_sb.py) -----
+    ("dense_sharded_sb", "gen",
+     "per-device cohort generation over the global keyspace — "
+     "compute-only", None),
+    ("dense_sharded_sb", "route",
+     "wave-1 request routing: per-owner compaction + all_to_all "
+     "exchange of lock/read requests (wL lanes of key+op)", "2*w*l*8"),
+    ("dense_sharded_sb", "arbitrate",
+     "owner-side no-wait S/X arbitration + fused balance read on the "
+     "local stamp/balance arrays", "5*w*l*4"),
+    ("dense_sharded_sb", "reply",
+     "grant/balance replies all_to_all back to sources + outcome "
+     "classification + compute_phase", "2*w*l*8"),
+    ("dense_sharded_sb", "install_route",
+     "wave-2 install routing to owners (all_to_all) + primary balance "
+     "install + the owner's CommitLog append", "w*l*8"),
+    ("dense_sharded_sb", "replicate",
+     "backup fan-out: ppermute applied installs to owner+1/+2, apply to "
+     "backup copies + append local logs", None),
+)
+
+
+def full_name(engine: str, wave: str) -> str:
+    return f"{PREFIX}.{engine}.{wave}"
+
+
+ALL_WAVES: tuple[str, ...] = tuple(
+    full_name(e, wv) for e, wv, _, _ in _REGISTRY)
+WAVE_DOCS: dict[str, str] = {
+    full_name(e, wv): doc for e, wv, doc, _ in _REGISTRY}
+WAVE_BYTES: dict[str, str | None] = {
+    full_name(e, wv): f for e, wv, _, f in _REGISTRY}
+ENGINES: tuple[str, ...] = tuple(dict.fromkeys(e for e, _, _, _ in _REGISTRY))
+WAVES_BY_ENGINE: dict[str, tuple[str, ...]] = {
+    eng: tuple(full_name(e, wv) for e, wv, _, _ in _REGISTRY if e == eng)
+    for eng in ENGINES}
+N_WAVES = len(ALL_WAVES)
+assert N_WAVES == len(set(ALL_WAVES)), "duplicate wave name in registry"
+
+
+def wave_bytes(name: str, **geometry) -> int | None:
+    """Evaluate a wave's expected-bytes-per-step formula against run
+    geometry (w=, k=, l=, vw=, d=...). Returns None for compute-only
+    waves and for formulas whose variables the caller did not supply —
+    attribution then reports time without a bandwidth figure instead of
+    inventing one."""
+    formula = WAVE_BYTES.get(name)
+    if formula is None:
+        return None
+    try:
+        v = eval(formula, {"__builtins__": {}},   # noqa: S307 — registry
+                 {k: v for k, v in geometry.items() if v is not None})
+    except NameError:
+        return None
+    try:
+        return int(v)
+    except (TypeError, ValueError):
+        return None
+
+
+def scopes_enabled() -> bool:
+    """DINT_SCOPE=0 disables the annotations (the A/B knob behind the
+    bit-identical pin); default on — the scopes are free when no profiler
+    is attached."""
+    return os.environ.get("DINT_SCOPE", "1") != "0"
+
+
+def scope(engine: str, wave: str):
+    """`jax.named_scope("dint.<engine>.<wave>")` for a REGISTERED wave —
+    annotating an unregistered name raises at trace time, so the registry
+    and the annotations cannot drift apart. Returns a null context when
+    scopes are disabled."""
+    name = full_name(engine, wave)
+    if name not in WAVE_DOCS:
+        raise KeyError(
+            f"wave {name!r} is not in the dintscope registry "
+            "(monitor/waves.py); append it there first")
+    if not scopes_enabled():
+        return contextlib.nullcontext()
+    import jax
+
+    return jax.named_scope(name)

@@ -47,6 +47,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..engines import tatp_dense as td
 from ..monitor import counters as mon
+from ..monitor import waves
 from ..ops import pallas_gather as pg
 from ..tables import log as logring
 from .sharded import SHARD_AXIS, make_mesh, pcast_varying   # noqa: F401 (re-exported)
@@ -190,19 +191,23 @@ def build_sharded_pipelined_runner(mesh: Mesh, n_shards: int,
         # in _apply_backup must consume the PPERMUTED record (fwd), not
         # the local one — commit-after-replication fails the gate if the
         # hop's payload is dropped on the floor.
-        for off in (1, 2):
-            perm = [(i, (i + off) % n_shards) for i in range(n_shards)]
-            fwd = jax.tree.map(functools.partial(
-                jax.lax.ppermute, axis_name=SHARD_AXIS, perm=perm), inst)
-            if cnt is not None:
-                # replication pushes, counted where they are APPLIED (the
-                # receiving backup — the reference's CommitBck handler)
-                hop = (mon.CTR_REPL_PUSH_HOP1 if off == 1
-                       else mon.CTR_REPL_PUSH_HOP2)
-                cnt = mon.bump(cnt, {hop: fwd.wmask.sum(dtype=jnp.int32)})
-            src_dev = (dev - off) % n_shards
-            state = _apply_backup(state, fwd, off - 1, n1, val_words,
-                                  src_dev)
+        with waves.scope("dense_sharded", "replicate"):
+            for off in (1, 2):
+                perm = [(i, (i + off) % n_shards) for i in range(n_shards)]
+                fwd = jax.tree.map(functools.partial(
+                    jax.lax.ppermute, axis_name=SHARD_AXIS, perm=perm),
+                    inst)
+                if cnt is not None:
+                    # replication pushes, counted where they are APPLIED
+                    # (the receiving backup — the reference's CommitBck
+                    # handler)
+                    hop = (mon.CTR_REPL_PUSH_HOP1 if off == 1
+                           else mon.CTR_REPL_PUSH_HOP2)
+                    cnt = mon.bump(cnt,
+                                   {hop: fwd.wmask.sum(dtype=jnp.int32)})
+                src_dev = (dev - off) % n_shards
+                state = _apply_backup(state, fwd, off - 1, n1, val_words,
+                                      src_dev)
         return state, new_ctx, c1, jax.lax.psum(stats, SHARD_AXIS), cnt
 
     def scan_fn(carry, key, gen_new=True):

@@ -64,6 +64,7 @@ from ..engines.smallbank_pipeline import (L, TS_AMT_MAX, VW, N_STATS,
                                           gen_cohort, _lock_slots)
 from ..engines.types import Op
 from ..monitor import counters as mon
+from ..monitor import waves
 from ..ops import pallas_gather as pg
 from ..tables import log as logring
 from .sharded import SHARD_AXIS, make_mesh, pcast_varying   # noqa: F401 (re-exported)
@@ -271,9 +272,10 @@ def build_sharded_sb_runner(mesh: Mesh, n_shards: int, n_accounts: int,
 
         # ---- wave 1: generate + route lock/read requests to owners ----
         if gen_new:
-            ttype, a1, a2 = gen_cohort(kgen, w, n_accounts, mix=mix,
-                                       **kw_gen)
-            l_op, l_tb, l_ac = _lock_slots(ttype, a1, a2)
+            with waves.scope("dense_sharded_sb", "gen"):
+                ttype, a1, a2 = gen_cohort(kgen, w, n_accounts, mix=mix,
+                                           **kw_gen)
+                l_op, l_tb, l_ac = _lock_slots(ttype, a1, a2)
         else:
             ttype = jnp.zeros((w,), I32)
             l_op = jnp.zeros((w, L), I32)
@@ -282,93 +284,101 @@ def build_sharded_sb_runner(mesh: Mesh, n_shards: int, n_accounts: int,
         ts_amt = jax.random.randint(kamt, (w,), -TS_AMT_MAX,
                                     TS_AMT_MAX + 1, dtype=I32)
 
-        active = (l_op != 0).reshape(-1)
-        dest = (l_ac.reshape(-1) % d).astype(I32)
-        row_loc = (l_tb.reshape(-1) * n_loc
-                   + l_ac.reshape(-1) // d).astype(I32)
-        pos = _positions(dest, active, d)
-        valid = active & (pos < cap)
+        with waves.scope("dense_sharded_sb", "route"):
+            active = (l_op != 0).reshape(-1)
+            dest = (l_ac.reshape(-1) % d).astype(I32)
+            row_loc = (l_tb.reshape(-1) * n_loc
+                       + l_ac.reshape(-1) // d).astype(I32)
+            pos = _positions(dest, active, d)
+            valid = active & (pos < cap)
 
-        r_op, r_row = _route(dest, pos, valid, cap, d,
-                             [l_op.reshape(-1), row_loc])
-        r_op = _a2a(r_op, d, cap)
-        r_row = _a2a(r_row, d, cap)
+            r_op, r_row = _route(dest, pos, valid, cap, d,
+                                 [l_op.reshape(-1), row_loc])
+            r_op = _a2a(r_op, d, cap)
+            r_row = _a2a(r_row, d, cap)
 
         # ---- owner side: no-wait S/X arbitration + fused read ---------
-        lanes = jnp.arange(d * cap, dtype=I32)
-        is_x = r_op == Op.ACQ_X_READ
-        is_s = r_op == Op.ACQ_S_READ
-        rows = jnp.where(r_op != 0, r_row, sent)
+        with waves.scope("dense_sharded_sb", "arbitrate"):
+            lanes = jnp.arange(d * cap, dtype=I32)
+            is_x = r_op == Op.ACQ_X_READ
+            is_s = r_op == Op.ACQ_S_READ
+            rows = jnp.where(r_op != 0, r_row, sent)
 
-        def mirror_idx(rr, mask):
-            """Local row -> hot mirror index (tbl*hot_loc + q), -1 cold.
-            The sentinel row (q == n_loc) is never hot: hot_loc <= n_loc."""
-            tb = (rr >= n_loc).astype(I32)
-            q = rr - tb * n_loc
-            return jnp.where(mask & (q < hot_loc), tb * hot_loc + q, -1)
+            def mirror_idx(rr, mask):
+                """Local row -> hot mirror index (tbl*hot_loc + q), -1
+                cold. The sentinel row (q == n_loc) is never hot:
+                hot_loc <= n_loc."""
+                tb = (rr >= n_loc).astype(I32)
+                q = rr - tb * n_loc
+                return jnp.where(mask & (q < hot_loc),
+                                 tb * hot_loc + q, -1)
 
-        if use_hotset:
-            midx = mirror_idx(rows, r_op != 0)
-        first_x = jnp.full((m1,), BIG, I32).at[
-            jnp.where(is_x, rows, oob)].min(lanes, mode="drop")
-        first_s = jnp.full((m1,), BIG, I32).at[
-            jnp.where(is_s, rows, oob)].min(lanes, mode="drop")
-        if use_hotset:
-            held_x = pg.hot_gather(state.x_step, state.hot_x, rows, midx,
-                                   1, use_pallas=use_pallas) == t - 1
-            held_s = pg.hot_gather(state.s_step, state.hot_s, rows, midx,
-                                   1, use_pallas=use_pallas) == t - 1
-        elif use_pallas:
-            held_x = pg.gather_rows(state.x_step, rows, 1) == t - 1
-            held_s = pg.gather_rows(state.s_step, rows, 1) == t - 1
-        else:
-            held_x = state.x_step[rows] == t - 1
-            held_s = state.s_step[rows] == t - 1
-        slot_free = ~held_x & ~held_s
-        x_wins = (first_x[rows] < first_s[rows]) & slot_free
-        grant_x = is_x & x_wins & (first_x[rows] == lanes)
-        grant_s = is_s & ~held_x & ~x_wins
-        s_writer = grant_s & (first_s[rows] == lanes)
-        x_step = state.x_step.at[jnp.where(grant_x, rows, oob)].set(
-            t, mode="drop", unique_indices=True)
-        s_step = state.s_step.at[
-            jnp.where(s_writer, rows, oob)].set(
-            t, mode="drop", unique_indices=True)
-        hot_x, hot_s = state.hot_x, state.hot_s
-        if use_hotset:
-            # stamp write-through (one-writer grant masks stay unique on
-            # the mirror's index subset)
-            hot_x = hot_x.at[jnp.where(grant_x & (midx >= 0), midx,
-                                       2 * hot_loc)].set(
+            if use_hotset:
+                midx = mirror_idx(rows, r_op != 0)
+            first_x = jnp.full((m1,), BIG, I32).at[
+                jnp.where(is_x, rows, oob)].min(lanes, mode="drop")
+            first_s = jnp.full((m1,), BIG, I32).at[
+                jnp.where(is_s, rows, oob)].min(lanes, mode="drop")
+            if use_hotset:
+                held_x = pg.hot_gather(state.x_step, state.hot_x, rows,
+                                       midx, 1,
+                                       use_pallas=use_pallas) == t - 1
+                held_s = pg.hot_gather(state.s_step, state.hot_s, rows,
+                                       midx, 1,
+                                       use_pallas=use_pallas) == t - 1
+            elif use_pallas:
+                held_x = pg.gather_rows(state.x_step, rows, 1) == t - 1
+                held_s = pg.gather_rows(state.s_step, rows, 1) == t - 1
+            else:
+                held_x = state.x_step[rows] == t - 1
+                held_s = state.s_step[rows] == t - 1
+            slot_free = ~held_x & ~held_s
+            x_wins = (first_x[rows] < first_s[rows]) & slot_free
+            grant_x = is_x & x_wins & (first_x[rows] == lanes)
+            grant_s = is_s & ~held_x & ~x_wins
+            s_writer = grant_s & (first_s[rows] == lanes)
+            x_step = state.x_step.at[jnp.where(grant_x, rows, oob)].set(
                 t, mode="drop", unique_indices=True)
-            hot_s = hot_s.at[jnp.where(s_writer & (midx >= 0), midx,
-                                       2 * hot_loc)].set(
+            s_step = state.s_step.at[
+                jnp.where(s_writer, rows, oob)].set(
                 t, mode="drop", unique_indices=True)
-        if use_hotset:
-            raw_bal = pg.hot_gather(state.bal, state.hot_bal, rows, midx,
-                                    1, use_pallas=use_pallas)
-        else:
-            raw_bal = (pg.gather_rows(state.bal, rows, 1) if use_pallas
-                       else state.bal[rows])
-        g_bal = jnp.where(grant_x | grant_s, raw_bal.astype(I32), 0)
+            hot_x, hot_s = state.hot_x, state.hot_s
+            if use_hotset:
+                # stamp write-through (one-writer grant masks stay unique
+                # on the mirror's index subset)
+                hot_x = hot_x.at[jnp.where(grant_x & (midx >= 0), midx,
+                                           2 * hot_loc)].set(
+                    t, mode="drop", unique_indices=True)
+                hot_s = hot_s.at[jnp.where(s_writer & (midx >= 0), midx,
+                                           2 * hot_loc)].set(
+                    t, mode="drop", unique_indices=True)
+            if use_hotset:
+                raw_bal = pg.hot_gather(state.bal, state.hot_bal, rows,
+                                        midx, 1, use_pallas=use_pallas)
+            else:
+                raw_bal = (pg.gather_rows(state.bal, rows, 1) if use_pallas
+                           else state.bal[rows])
+            g_bal = jnp.where(grant_x | grant_s, raw_bal.astype(I32), 0)
 
         # ---- replies back to sources + classify -----------------------
-        rep_g = _a2a((grant_x | grant_s), d, cap)
-        rep_b = _a2a(g_bal, d, cap)
-        back = jnp.where(valid, dest * cap + pos, 0)
-        granted = (jnp.where(valid, rep_g[back], False)
-                   .reshape(w, L))
-        bal = jnp.where(granted, rep_b[back].reshape(w, L), 0)
-        # overflowed lanes have valid=False -> granted=False, so the
-        # no-wait reject covers them (the reference client's retry
-        # under overload, here a bounded no-wait reject)
-        lock_rejected = ((l_op != 0) & ~granted).any(axis=1)
-        alive = ~lock_rejected & (l_op[:, 0] != 0)
+        with waves.scope("dense_sharded_sb", "reply"):
+            rep_g = _a2a((grant_x | grant_s), d, cap)
+            rep_b = _a2a(g_bal, d, cap)
+            back = jnp.where(valid, dest * cap + pos, 0)
+            granted = (jnp.where(valid, rep_g[back], False)
+                       .reshape(w, L))
+            bal = jnp.where(granted, rep_b[back].reshape(w, L), 0)
+            # overflowed lanes have valid=False -> granted=False, so the
+            # no-wait reject covers them (the reference client's retry
+            # under overload, here a bounded no-wait reject)
+            lock_rejected = ((l_op != 0) & ~granted).any(axis=1)
+            alive = ~lock_rejected & (l_op[:, 0] != 0)
 
-        nw, do, logic_abort, commit, committed = compute_phase(
-            ttype, bal, alive, ts_amt)
-        do_write = do & commit[:, None] & (l_op != 0)
-        bal_delta = jnp.sum(jnp.where(do_write, nw - bal, 0), dtype=I32)
+            nw, do, logic_abort, commit, committed = compute_phase(
+                ttype, bal, alive, ts_amt)
+            do_write = do & commit[:, None] & (l_op != 0)
+            bal_delta = jnp.sum(jnp.where(do_write, nw - bal, 0),
+                                dtype=I32)
 
         new_ctx = SBCtx(
             acc=l_ac, tbl=l_tb, do_write=do_write, nw=nw,
@@ -381,33 +391,35 @@ def build_sharded_sb_runner(mesh: Mesh, n_shards: int, n_accounts: int,
             overflow=(active & ~valid).sum(dtype=I32))
 
         # ---- wave 2 of c1: route installs to owners -------------------
-        wmask = c1.do_write.reshape(-1)
-        wdest = (c1.acc.reshape(-1) % d).astype(I32)
-        wrow = (c1.tbl.reshape(-1) * n_loc
-                + c1.acc.reshape(-1) // d).astype(I32)
-        wpos = _positions(wdest, wmask, d)
-        wvalid = wmask & (wpos < cap)   # cannot overflow: writes <= locks
-        i_m, i_row, i_bal, i_tbl, i_acc = _route(
-            wdest, wpos, wvalid, cap, d,
-            [wmask.astype(I32), wrow, c1.nw.reshape(-1),
-             c1.tbl.reshape(-1), c1.acc.reshape(-1)])
-        inst = [_a2a(x, d, cap) for x in (i_m, i_row, i_bal, i_tbl, i_acc)]
-        i_m, i_row, i_bal, i_tbl, i_acc = inst
-        i_mask = i_m != 0
+        with waves.scope("dense_sharded_sb", "install_route"):
+            wmask = c1.do_write.reshape(-1)
+            wdest = (c1.acc.reshape(-1) % d).astype(I32)
+            wrow = (c1.tbl.reshape(-1) * n_loc
+                    + c1.acc.reshape(-1) // d).astype(I32)
+            wpos = _positions(wdest, wmask, d)
+            wvalid = wmask & (wpos < cap)   # no overflow: writes <= locks
+            i_m, i_row, i_bal, i_tbl, i_acc = _route(
+                wdest, wpos, wvalid, cap, d,
+                [wmask.astype(I32), wrow, c1.nw.reshape(-1),
+                 c1.tbl.reshape(-1), c1.acc.reshape(-1)])
+            inst = [_a2a(x, d, cap)
+                    for x in (i_m, i_row, i_bal, i_tbl, i_acc)]
+            i_m, i_row, i_bal, i_tbl, i_acc = inst
+            i_mask = i_m != 0
 
-        irows = jnp.where(i_mask, i_row, oob)
-        hot_bal = state.hot_bal
-        if use_hotset:
-            # partitioned write-through install (fused kernel on pallas,
-            # double 1-D unique-index scatter on XLA)
-            i_midx = mirror_idx(i_row, i_mask)
-            bal_new, hot_bal = pg.hot_scatter(
-                state.bal, hot_bal, i_row, i_midx, i_mask,
-                i_bal.astype(U32), 1, use_pallas=use_pallas)
-        else:
-            bal_new = state.bal.at[irows].set(i_bal.astype(U32),
-                                              mode="drop",
-                                              unique_indices=True)
+            irows = jnp.where(i_mask, i_row, oob)
+            hot_bal = state.hot_bal
+            if use_hotset:
+                # partitioned write-through install (fused kernel on
+                # pallas, double 1-D unique-index scatter on XLA)
+                i_midx = mirror_idx(i_row, i_mask)
+                bal_new, hot_bal = pg.hot_scatter(
+                    state.bal, hot_bal, i_row, i_midx, i_mask,
+                    i_bal.astype(U32), 1, use_pallas=use_pallas)
+            else:
+                bal_new = state.bal.at[irows].set(i_bal.astype(U32),
+                                                  mode="drop",
+                                                  unique_indices=True)
 
         def mk_entry(mask, row, balv, tblv, accv, ring, bck, slot, src_dev):
             # forwarded entries tag key_hi = SOURCE device + 1 (own entries
@@ -427,28 +439,31 @@ def build_sharded_sb_runner(mesh: Mesh, n_shards: int, n_accounts: int,
             return ring, bck
 
         # owner logs its installs (CommitLog at the primary)
-        newval = jnp.zeros((d * cap, VW), U32).at[:, 0].set(
-            i_bal.astype(U32))
-        log = logring.append_rep(state.log, i_mask, i_tbl,
-                                 jnp.zeros_like(i_bal),
-                                 jnp.zeros_like(i_bal, U32),
-                                 i_acc.astype(U32),
-                                 jnp.broadcast_to(t, i_mask.shape), newval)
+        with waves.scope("dense_sharded_sb", "install_route"):
+            newval = jnp.zeros((d * cap, VW), U32).at[:, 0].set(
+                i_bal.astype(U32))
+            log = logring.append_rep(state.log, i_mask, i_tbl,
+                                     jnp.zeros_like(i_bal),
+                                     jnp.zeros_like(i_bal, U32),
+                                     i_acc.astype(U32),
+                                     jnp.broadcast_to(t, i_mask.shape),
+                                     newval)
         # CommitBck x2 + CommitLog at the backups: forward applied installs
-        bck = state.bck_bal
-        for off in (1, 2):
-            perm = [(i, (i + off) % d) for i in range(d)]
-            pp = functools.partial(jax.lax.ppermute, axis_name=AXIS,
-                                   perm=perm)
-            fwd_mask = pp(i_mask)
-            if cnt is not None:
-                # replication pushes, counted where they are APPLIED
-                hop = (mon.CTR_REPL_PUSH_HOP1 if off == 1
-                       else mon.CTR_REPL_PUSH_HOP2)
-                cnt = mon.bump(cnt, {hop: fwd_mask.sum(dtype=I32)})
-            log, bck = mk_entry(fwd_mask, pp(i_row), pp(i_bal),
-                                pp(i_tbl), pp(i_acc), log, bck, off - 1,
-                                (dev - off) % d)
+        with waves.scope("dense_sharded_sb", "replicate"):
+            bck = state.bck_bal
+            for off in (1, 2):
+                perm = [(i, (i + off) % d) for i in range(d)]
+                pp = functools.partial(jax.lax.ppermute, axis_name=AXIS,
+                                       perm=perm)
+                fwd_mask = pp(i_mask)
+                if cnt is not None:
+                    # replication pushes, counted where they are APPLIED
+                    hop = (mon.CTR_REPL_PUSH_HOP1 if off == 1
+                           else mon.CTR_REPL_PUSH_HOP2)
+                    cnt = mon.bump(cnt, {hop: fwd_mask.sum(dtype=I32)})
+                log, bck = mk_entry(fwd_mask, pp(i_row), pp(i_bal),
+                                    pp(i_tbl), pp(i_acc), log, bck,
+                                    off - 1, (dev - off) % d)
 
         state = state.replace(bal=bal_new, bck_bal=bck, x_step=x_step,
                               s_step=s_step, step=t + 1, log=log,

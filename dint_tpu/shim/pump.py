@@ -17,17 +17,29 @@ analogue of the reference's run-to-completion prefetch pipeline
 from __future__ import annotations
 
 import threading
+import time
 
 import jax
 import numpy as np
 
 from ..engines.types import make_batch
+from ..stats import LatencyHistogram
 from .native import VAL_SIZE, ShimServer
 from .wire import Profile
 
 
 class EnginePump:
-    """Owns engine state; serves batches arriving on a ShimServer."""
+    """Owns engine state; serves batches arriving on a ShimServer.
+
+    Open-loop arrival accounting (dintscope SLO sensors): every batch is
+    timestamped at poll return (arrival to the host), at step dispatch,
+    and at reply scatter, and two exact-merge histograms record the split
+    — ``queue_hist`` (arrival -> dispatch: host-side hold) and
+    ``service_hist`` (dispatch -> replies on the wire: device execution +
+    fetch + scatter, which under the double-buffered loop includes the
+    overlap slack). One sample per batch; `latency_snapshot()` serializes
+    both for artifacts, so queueing delay is recorded separately from
+    service time instead of being folded into one client RTT."""
 
     def __init__(self, profile: Profile, step_fn, state, width: int = 4096,
                  port: int = 0, flush_us: int = 200, val_words: int = 10):
@@ -40,10 +52,12 @@ class EnginePump:
                                  fmt=profile.fmt)
         self.port = self.server.port
         self.batches_served = 0
+        self.queue_hist = LatencyHistogram()
+        self.service_hist = LatencyHistogram()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
-    def _dispatch(self, got):
+    def _dispatch(self, got, t_arrival: float | None = None):
         """Parse a polled batch and dispatch the jitted step (async).
         The C++ ring slot's views are fully consumed here (make_batch
         copies to device buffers), so only the slot id + reply metadata
@@ -57,13 +71,16 @@ class EnginePump:
         batch = make_batch(ops, b["key"], vals=vals, vers=b["ver"],
                            tables=b["table"].astype(np.int32),
                            width=self.width, val_words=self.val_words)
+        t_disp = time.monotonic()
         self.state, replies = self._step(self.state, batch)
-        return slot, n, wire_type, replies
+        if t_arrival is not None:
+            self.queue_hist.add(max(t_disp - t_arrival, 0.0) * 1e6)
+        return slot, n, wire_type, replies, t_disp
 
     def _finish(self, pending):
         """Fetch a dispatched batch's replies (value fetch = sync) and
         scatter them back over the wire."""
-        slot, n, wire_type, replies = pending
+        slot, n, wire_type, replies, t_disp = pending
         rtype = np.asarray(replies.rtype)[:n]
         rval32 = np.asarray(replies.val)[:n]
         rver = np.asarray(replies.ver)[:n]
@@ -72,7 +89,20 @@ class EnginePump:
         rval[:, :self.val_words * 4] = np.ascontiguousarray(
             rval32[:, :self.val_words]).view(np.uint8).reshape(n, -1)
         self.server.reply(slot, wire_reply, rval, rver)
+        self.service_hist.add((time.monotonic() - t_disp) * 1e6)
         self.batches_served += 1
+
+    def latency_snapshot(self) -> dict:
+        """Queue/service split for artifacts: percentiles + the exact
+        histograms (one sample per served batch)."""
+        def side(h):
+            return {**{f"{k}_us": round(v, 2)
+                       for k, v in h.percentiles().items()},
+                    "hist": h.to_dict()}
+
+        return {"batches": self.batches_served,
+                "queue": side(self.queue_hist),
+                "service": side(self.service_hist)}
 
     def serve_one(self, timeout_us: int = 100_000) -> bool:
         """Poll one batch, certify, reply (synchronous single-batch path).
@@ -80,7 +110,7 @@ class EnginePump:
         got = self.server.poll(timeout_us)
         if got is None:
             return False
-        self._finish(self._dispatch(got))
+        self._finish(self._dispatch(got, time.monotonic()))
         return True
 
     def serve_forever(self):
@@ -93,7 +123,8 @@ class EnginePump:
         while not self._stop.is_set():
             got = self.server.poll(
                 timeout_us=0 if pending is not None else 50_000)
-            new = self._dispatch(got) if got is not None else None
+            t_arr = time.monotonic() if got is not None else None
+            new = self._dispatch(got, t_arr) if got is not None else None
             if pending is not None:
                 self._finish(pending)
             pending = new

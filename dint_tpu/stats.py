@@ -74,20 +74,143 @@ class StatClock:
         return self._measure_t1 - self._measure_t0
 
 
+class LatencyHistogram:
+    """Fixed log-bucketed latency histogram (µs) — the dintscope SLO
+    sensor that rides NEXT TO the reservoir (bench/exp artifacts carry it
+    as the "lat_hist" block alongside the percentile block).
+
+    Why a second structure when `LatencyReservoir` already exists: the
+    reservoir is exact until `cap` and then SAMPLED — merging two
+    downsampled reservoirs (cross-shard, cross-window) is approximate and
+    order-dependent. Bucket counts add exactly: `merge` is associative
+    and commutative, so per-shard / per-window histograms compose into
+    run totals with zero loss (the same property the reference gets from
+    per-CPU counter maps), which is what an always-on serving plane needs
+    for SLO accounting. The price is resolution: 8 buckets per octave
+    (width 2^(1/8) ≈ 9.05%), so a percentile read off the histogram is
+    within ±2^(1/16)-1 ≈ 4.4% relative error of the exact nth-element
+    value (buckets represent by their geometric midpoint; bounded-error
+    contract pinned in tests/test_stats.py).
+
+    Range: 2^-4 µs .. 2^28 µs (~4.5 min), 256 buckets; out-of-range
+    samples clamp to the edge buckets (the bound does not cover them).
+    Totality matches the round-3 reservoir contract: empty -> zeros,
+    n == 1 -> every percentile is the same defined value, non-finite
+    samples are excluded (counted in `dropped_nonfinite`), never NaN.
+    """
+
+    LO_EXP = -4
+    HI_EXP = 28
+    PER_OCTAVE = 8
+    N_BUCKETS = (HI_EXP - LO_EXP) * PER_OCTAVE
+    SCHEMA = 1
+
+    def __init__(self):
+        self.counts = np.zeros(self.N_BUCKETS, np.int64)
+        self.n = 0
+        self.sum_us = 0.0
+        self.dropped_nonfinite = 0
+
+    def add(self, lat_us: np.ndarray | float):
+        arr = np.atleast_1d(np.asarray(lat_us, np.float64))
+        finite = np.isfinite(arr)
+        self.dropped_nonfinite += int(len(arr) - finite.sum())
+        arr = arr[finite]
+        if not len(arr):
+            return
+        # log2 of a non-positive sample is -inf -> clamps to bucket 0
+        with np.errstate(divide="ignore"):
+            idx = np.floor(np.log2(np.maximum(arr, 0.0))
+                           * self.PER_OCTAVE) - self.LO_EXP * self.PER_OCTAVE
+        idx = np.clip(np.nan_to_num(idx, neginf=0.0), 0,
+                      self.N_BUCKETS - 1).astype(np.int64)
+        np.add.at(self.counts, idx, 1)
+        self.n += len(arr)
+        self.sum_us += float(arr.sum())
+
+    def merge(self, other: "LatencyHistogram"):
+        """Exact, associative, commutative: bucket counts add. Returns
+        self (accumulator style: `total.merge(shard_a).merge(shard_b)`)."""
+        self.counts += other.counts
+        self.n += other.n
+        self.sum_us += other.sum_us
+        self.dropped_nonfinite += other.dropped_nonfinite
+        return self
+
+    def _edge(self, i: int) -> float:
+        return 2.0 ** (self.LO_EXP + i / self.PER_OCTAVE)
+
+    def _rep(self, i: int) -> float:
+        """Bucket representative: geometric midpoint of its edges."""
+        return 2.0 ** (self.LO_EXP + (i + 0.5) / self.PER_OCTAVE)
+
+    def quantile(self, q: float) -> float:
+        """Value at quantile q in [0, 1] (0 when empty): the
+        representative of the bucket holding the ceil(q*n)-th sample —
+        the histogram analogue of nth_element."""
+        if self.n == 0:
+            return 0.0
+        rank = min(max(int(np.ceil(q * self.n)), 1), self.n)
+        cum = np.cumsum(self.counts)
+        i = int(np.searchsorted(cum, rank))
+        return self._rep(i)
+
+    def percentiles(self) -> dict:
+        """Same keys/totality as LatencyReservoir.percentiles."""
+        if self.n == 0:
+            return dict(avg=0.0, p50=0.0, p99=0.0, p999=0.0)
+        return dict(avg=self.sum_us / self.n, p50=self.quantile(0.50),
+                    p99=self.quantile(0.99), p999=self.quantile(0.999))
+
+    def to_dict(self) -> dict:
+        """Sparse, schema-stable serialization (artifact "lat_hist"
+        block): only non-zero buckets, keyed by index."""
+        return {
+            "schema": self.SCHEMA,
+            "lo_exp": self.LO_EXP, "per_octave": self.PER_OCTAVE,
+            "n": int(self.n), "sum_us": round(self.sum_us, 3),
+            "dropped_nonfinite": int(self.dropped_nonfinite),
+            "buckets": {str(i): int(c) for i, c in enumerate(self.counts)
+                        if c},
+            **{f"{k}_us": round(v, 2)
+               for k, v in self.percentiles().items()},
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "LatencyHistogram":
+        if d.get("lo_exp", cls.LO_EXP) != cls.LO_EXP or \
+                d.get("per_octave", cls.PER_OCTAVE) != cls.PER_OCTAVE:
+            raise ValueError("histogram bucket geometry mismatch")
+        h = cls()
+        for i, c in (d.get("buckets") or {}).items():
+            h.counts[int(i)] = int(c)
+        h.n = int(d.get("n", int(h.counts.sum())))
+        h.sum_us = float(d.get("sum_us", 0.0))
+        h.dropped_nonfinite = int(d.get("dropped_nonfinite", 0))
+        return h
+
+
 class LatencyReservoir:
     """Latency sample store (µs). The reference keeps every sample in a
     per-thread vector and nth_element's it (store/caladan/stat.h:15-20);
-    we keep up to `cap` samples with reservoir downsampling past that."""
+    we keep up to `cap` samples with reservoir downsampling past that.
+
+    Every sample is ALSO counted into a `LatencyHistogram` (`self.hist`):
+    the reservoir serves exact percentiles for one window, the histogram
+    serves exact cross-shard/cross-window merges and the artifact
+    "lat_hist" block — two views of the same stream."""
 
     def __init__(self, cap: int = 1 << 20, seed: int = 0):
         self.cap = cap
         self.samples = np.empty(cap, np.float64)
         self.n_kept = 0
         self.n_seen = 0
+        self.hist = LatencyHistogram()
         self._rng = np.random.default_rng(seed)
 
     def add(self, lat_us: np.ndarray | float):
         arr = np.atleast_1d(np.asarray(lat_us, np.float64))
+        self.hist.add(arr)
         for start in range(0, len(arr), self.cap):
             self._add_chunk(arr[start:start + self.cap])
 
@@ -203,6 +326,7 @@ def cohort_latency_percentiles(block_s, cohorts_per_block: int, depth: int):
             lat.add(((depth - spill) * step[b] + spill * s_next) * 1e6)
     out = lat.percentiles()
     out["n"] = lat.n_seen
+    out["hist"] = lat.hist.to_dict()    # the artifact "lat_hist" block
     return out
 
 
@@ -253,6 +377,7 @@ def run_latency_window(runner, state, key, window_s: float, n_stats: int,
         lat.add(samples)
     out = lat.percentiles()
     out["n"] = lat.n_seen
+    out["hist"] = lat.hist.to_dict()
     return state, total, dt, i, out
 
 
@@ -398,11 +523,15 @@ class Recorder:
     def block(self, elapsed_s: float) -> MetricBlock:
         p = self.lat.percentiles()
         el = max(elapsed_s, 1e-12)
+        extra = dict(self.extra)
+        # the exact-merge histogram rides every metric block next to the
+        # reservoir percentiles (artifact schema hygiene, OBSERVABILITY.md)
+        extra.setdefault("lat_hist", self.lat.hist.to_dict())
         return MetricBlock(
             throughput=self.attempted / el,
             goodput=self.committed / el,
             avg_us=p["avg"], p50_us=p["p50"], p99_us=p["p99"],
             p999_us=p["p999"],
             device_duty=self.device_busy_s / el,
-            extra=dict(self.extra),
+            extra=extra,
         )

@@ -54,7 +54,9 @@ def _percentiles(samples_us):
     lat = st.LatencyReservoir()
     for s in samples_us:
         lat.add(s)
-    return lat.percentiles()
+    p = lat.percentiles()
+    p["hist"] = lat.hist.to_dict()
+    return p
 
 
 def _monitor_on() -> bool:
@@ -120,8 +122,16 @@ def pipeline_open(make_runner, n_stats, *, rate, window_s, w, cpb, depth,
     shows up as schedule slip -> latency growth.
 
     make_runner() -> (run, carry, drain): fresh state per rate point.
-    Returns (totals, dt, percentiles, offered_rate, blocks_dispatched)."""
+    Returns (totals, dt, percentiles, offered_rate, blocks_dispatched,
+    split) where ``split`` separates QUEUEING delay (schedule slip at
+    dispatch: how long past its scheduled arrival a block waited for the
+    device) from SERVICE time (dispatch -> completion) — the honest
+    decomposition of the latency-vs-load hockey stick: under saturation
+    the queue term grows without bound while service stays ~flat. Each
+    carries the percentile dict + the exact-merge histogram."""
     import jax
+
+    from dint_tpu import stats as st
 
     run, carry, drain = make_runner()
     key = jax.random.PRNGKey(key_seed)
@@ -134,6 +144,8 @@ def pipeline_open(make_runner, n_stats, *, rate, window_s, w, cpb, depth,
     period = cpb * w / rate            # seconds per block
     total = np.zeros(n_stats, np.int64)
     lat_blocks = []
+    queue_lat = st.LatencyReservoir()      # open-loop arrival timestamps:
+    service_lat = st.LatencyReservoir()    # queueing vs service, separated
     t0 = time.time()
     i = 0
     while time.time() - t0 < window_s:
@@ -141,19 +153,29 @@ def pipeline_open(make_runner, n_stats, *, rate, window_s, w, cpb, depth,
         now = time.time()
         if sched > now:
             time.sleep(sched - now)
+        t_disp = time.time()
         carry, s = run(carry, jax.random.fold_in(key, i))
         total += np.asarray(s, np.int64).sum(axis=0)   # fetch = completion
         done = time.time()
         # per-cohort arrivals spread across the block's schedule slot
         arr = sched + np.arange(cpb) * (w / rate)
         lat_blocks.append(np.maximum(done - arr, 0.0) * 1e6)
+        queue_lat.add(max(t_disp - sched, 0.0) * 1e6)
+        service_lat.add((done - t_disp) * 1e6)
         i += 1
     dt = time.time() - t0
     tail, _ = _drain(drain, carry)
     total += np.asarray(tail, np.int64).sum(axis=0)
     p = _percentiles(lat_blocks)
     offered = i * cpb * w / dt
-    return total, dt, p, offered, i
+
+    def _side(lat):
+        d = {f"{k}_us": round(v, 2) for k, v in lat.percentiles().items()}
+        d["hist"] = lat.hist.to_dict()
+        return d
+
+    split = {"queue": _side(queue_lat), "service": _side(service_lat)}
+    return total, dt, p, offered, i, split
 
 
 # ---------------------------------------------------------------- workloads
@@ -277,26 +299,54 @@ def run_point(results, name, fn, attempts=2, backoff_s=30):
     return False
 
 
-def _metric_json(att, com, dt, p, extra):
+def _metric_json(att, com, dt, p, extra, breakdown=None):
+    from dint_tpu.monitor import attrib
     from dint_tpu.stats import MetricBlock
 
-    return MetricBlock(
+    d = MetricBlock(
         throughput=att / dt, goodput=com / dt,
         avg_us=p["avg"], p50_us=p["p50"], p99_us=p["p99"],
         p999_us=p["p999"], extra=extra).to_dict()
+    # artifact schema hygiene (OBSERVABILITY.md): every sweep point
+    # carries the schema version, the log-bucket histogram next to the
+    # percentile block, and a breakdown that is an object exactly when
+    # dintscope attribution ran (explicit null otherwise)
+    d["schema"] = attrib.ARTIFACT_SCHEMA
+    d["lat_hist"] = p.get("hist")
+    d["breakdown"] = breakdown
+    return d
 
 
 def sweep_pipeline(name, runner_fn, extras_fn, n_stats, *, widths, cpb,
                    depth, magic_idx, window_s, open_rates, results,
-                   lat_widths=(), point_extra=None):
+                   lat_widths=(), point_extra=None, geom=None):
     """Closed-loop width sweep, then open-loop rate sweep at the widest
     width relative to its measured peak, then latency-mode points
     (cohorts_per_block=1, per-step sync fetch) whose percentiles come
     from MEASURED timestamps rather than the block-time model.
     ``point_extra`` (dict) is recorded verbatim in every point's extras
-    (skew/hot-tier provenance)."""
+    (skew/hot-tier provenance). ``geom`` (dict: k/l/vw formula vars) feeds
+    the dintscope bytes formulas when DINT_EXP_TRACE_DIR attribution is
+    on."""
     peak = None
     peak_w = None
+
+    def _breakdown(w):
+        """Attribute the point's freshest profiler trace when
+        DINT_EXP_TRACE_DIR is set (pipeline_closed brackets the window
+        with a profiler session into that dir); explicit None otherwise —
+        a failed attribution must not void the sweep point."""
+        tdir = os.environ.get("DINT_EXP_TRACE_DIR")
+        if not tdir:
+            return None
+        try:
+            from dint_tpu.monitor import attrib
+
+            return attrib.report(tdir, geometry=dict(geom or {}, w=w))
+        except Exception as e:      # noqa: BLE001
+            print(f"dintscope attribution failed: {e!r}"[:200],
+                  flush=True)
+            return None
 
     def closed_point(w):
         def fn():
@@ -311,7 +361,8 @@ def sweep_pipeline(name, runner_fn, extras_fn, n_stats, *, widths, cpb,
             extra.update(point_extra or {})
             # end-of-point dintmon snapshot; explicit null when off
             extra["counters"] = counters
-            return _metric_json(att, com, dt, p, extra)
+            return _metric_json(att, com, dt, p, extra,
+                                breakdown=_breakdown(w))
 
         return fn
 
@@ -332,14 +383,18 @@ def sweep_pipeline(name, runner_fn, extras_fn, n_stats, *, widths, cpb,
     def open_point(frac):
         def fn():
             rate = max(peak * frac, 1.0)
-            total, dt, p, offered, _ = pipeline_open(
+            total, dt, p, offered, _, split = pipeline_open(
                 lambda: runner_fn(peak_w, cpb), n_stats, rate=rate,
                 window_s=window_s, w=peak_w, cpb=cpb, depth=depth)
             att, com, extra = extras_fn(total)
             extra.update(mode="open", width=peak_w,
                          target_rate=round(rate, 1),
                          offered_rate=round(offered, 1),
-                         load_frac=frac)
+                         load_frac=frac,
+                         # queueing delay vs service time, separated from
+                         # the scheduled-arrival timestamps (the SLO
+                         # sensors the serving plane closes its loop on)
+                         queue=split["queue"], service=split["service"])
             return _metric_json(att, com, dt, p, extra)
 
         return fn
@@ -603,10 +658,15 @@ def _store_wire_bench(window_s, quick):
         for t in threads:
             t.join()
         dt = time.time() - t0
+        pump_lat = pump.latency_snapshot()
 
+    # cross-client merge: reservoirs re-add kept samples (approximate past
+    # cap); the histograms merge EXACTLY (stats.LatencyHistogram)
     agg = LatencyReservoir()
     for lr in lats:
         agg.add(lr.samples[:lr.n_kept])
+        if lr is not lats[0]:
+            lats[0].hist.merge(lr.hist)
     p = agg.percentiles()
     return MetricBlock(
         throughput=float(sent.sum()) / dt,
@@ -614,7 +674,9 @@ def _store_wire_bench(window_s, quick):
         avg_us=p["avg"], p50_us=p["p50"], p99_us=p["p99"],
         p999_us=p["p999"],
         extra={"unit": "pkt/s", "clients": n_clients, "wave": wave,
-               "transport": "udp_loopback_shim"}).to_dict()
+               "transport": "udp_loopback_shim",
+               "lat_hist": lats[0].hist.to_dict(),
+               "pump": pump_lat}).to_dict()
 
 
 def _tatp_wire_bench(window_s, quick):
@@ -718,10 +780,13 @@ def _tatp_wire_bench(window_s, quick):
         for t in threads:
             t.join()
         dt = time.time() - t0
+        pump_lat = pump.latency_snapshot()
 
     agg = LatencyReservoir()
     for lr in lats:
         agg.add(lr.samples[:lr.n_kept])
+        if lr is not lats[0]:
+            lats[0].hist.merge(lr.hist)
     p = agg.percentiles()
     return MetricBlock(
         throughput=float(sent.sum()) / dt,
@@ -731,7 +796,9 @@ def _tatp_wire_bench(window_s, quick):
         extra={"unit": "pkt/s", "clients": n_clients, "wave": wave,
                "lock_grants": int(grants.sum()),
                "n_subscribers": n_sub,
-               "transport": "udp_loopback_shim"}).to_dict()
+               "transport": "udp_loopback_shim",
+               "lat_hist": lats[0].hist.to_dict(),
+               "pump": pump_lat}).to_dict()
 
 
 def _tatp_wire_txn_bench(window_s, quick):
@@ -878,7 +945,8 @@ def run_all(out: str, window_s: float = 10.0, quick: bool = False,
                        _tatp_extras, td.N_STATS, widths=widths, cpb=cpb,
                        depth=3, magic_idx=td.STAT_MAGIC_BAD,
                        window_s=window_s, open_rates=rates, results=results,
-                       lat_widths=lat_widths)
+                       lat_widths=lat_widths,
+                       geom={"k": td.K, "vw": 10})
     skew_preset = only is not None and "skew" in only
     if want("smallbank") and not skew_preset:
         from dint_tpu.clients import workloads as wl
@@ -898,7 +966,8 @@ def run_all(out: str, window_s: float = 10.0, quick: bool = False,
                        _sb_extras, sd.N_STATS, widths=widths, cpb=cpb,
                        depth=2, magic_idx=sd.STAT_MAGIC_BAD,
                        window_s=window_s, open_rates=rates, results=results,
-                       lat_widths=lat_widths, point_extra=skew_extra)
+                       lat_widths=lat_widths, point_extra=skew_extra,
+                       geom={"l": sd.L, "vw": sd.VW})
 
     if skew_preset:
         # skew-sweep preset (--only smallbank_skew): one width, hot_frac
@@ -918,7 +987,8 @@ def run_all(out: str, window_s: float = 10.0, quick: bool = False,
                 point_extra={"hot_frac": frac,
                              "hot_prob": (0.9 if hot_prob is None
                                           else float(hot_prob)),
-                             "use_hotset": pg.resolve_use_hotset(None)})
+                             "use_hotset": pg.resolve_use_hotset(None)},
+                geom={"l": sd.L, "vw": sd.VW})
     sweep_micro(window_s, quick, results, want=want)  # self-gates per point
 
     summary = {"configs": sorted(results),

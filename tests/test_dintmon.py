@@ -420,6 +420,39 @@ def test_monitor_observe_and_chrome_export(tmp_path):
     assert any(e.get("ph") == "C" for e in tr["traceEvents"])
 
 
+def test_monitor_deferred_drain_deltas_bit_identical(tmp_path):
+    """The dintscope double-buffered drain (observe(defer=True): block
+    i-1's ~100-byte fetch materializes only after block i dispatched, via
+    an on-device copy that survives the carry donation) must emit the
+    SAME wave-event counter deltas as the synchronous path — only WHEN
+    the bytes cross to the host changes, never what they say."""
+    from dint_tpu.engines import tatp_dense as td
+
+    def run_stream(defer):
+        p = str(tmp_path / f"run_{int(defer)}.jsonl")
+        db = td.populate(np.random.default_rng(0), N_SUB, val_words=VW)
+        run, init, drain = _td_build(True)
+        carry = init(db)
+        with M.TraceWriter(p, meta={"name": "defer_pin"}) as writer:
+            monitor = M.Monitor(writer)
+            for i in range(4):
+                carry, _ = run(carry, jax.random.fold_in(KEY(0), i))
+                monitor.observe(carry[-1], batch=CPB * W, dur_s=0.01,
+                                defer=defer)
+            last = monitor.flush()   # lands the deferred final window
+            assert (last is None) == (not defer)
+        _, waves_ev = M.read_events(p)
+        return waves_ev, monitor.totals
+
+    sync_waves, sync_totals = run_stream(False)
+    defr_waves, defr_totals = run_stream(True)
+    assert len(sync_waves) == len(defr_waves) == 4
+    for a, b in zip(sync_waves, defr_waves):
+        assert a["step"] == b["step"] and a["batch"] == b["batch"]
+        assert a["counters"] == b["counters"]
+    assert sync_totals == defr_totals
+
+
 def test_profiler_session_noop_and_bad_dir(tmp_path):
     from dint_tpu.monitor.trace import profiler_session
 

@@ -9,7 +9,8 @@ measurement silently stops being auditable.
 import numpy as np
 import pytest
 
-from dint_tpu.stats import LatencyReservoir, cohort_latency_percentiles
+from dint_tpu.stats import (LatencyHistogram, LatencyReservoir,
+                            cohort_latency_percentiles)
 
 
 def test_empty_reservoir_returns_zeros_not_nan():
@@ -81,3 +82,119 @@ def test_cohort_latency_percentiles_empty_blocks():
     out = cohort_latency_percentiles([], cohorts_per_block=4, depth=3)
     assert out["n"] == 0
     assert out["p99"] == 0.0 and np.isfinite(out["p999"])
+    # the artifact "lat_hist" block rides next to the percentile dict
+    assert out["hist"]["n"] == 0 and out["hist"]["buckets"] == {}
+
+
+# ------------------------- LatencyHistogram (the dintscope SLO sensor) --
+
+# the documented bound: buckets are 2^(1/8) wide and represent by their
+# geometric midpoint, so an in-range percentile is within 2^(1/16)-1 of
+# the exact nth-element value
+HIST_REL_ERR = 2 ** (1 / 16) - 1
+
+
+def test_histogram_percentiles_bounded_relative_error_vs_exact():
+    """Log-bucket quantiles vs the exact nth-element on small samples
+    (the reference's store/caladan/stat.h:15-20 semantics, which the
+    histogram's ceil-rank read mirrors): every quantile within the
+    documented relative-error bound."""
+    rng = np.random.default_rng(7)
+    for sample in (np.geomspace(1.0, 1e5, 333),
+                   rng.lognormal(5.0, 2.0, 500),
+                   np.full(100, 42.0),
+                   np.array([3.0, 3000.0])):
+        res = LatencyReservoir()
+        hist = LatencyHistogram()
+        res.add(sample)
+        hist.add(sample)
+        srt = np.sort(sample)
+        for q in (0.50, 0.99, 0.999):
+            exact = srt[min(max(int(np.ceil(q * len(srt))), 1),
+                            len(srt)) - 1]
+            assert hist.quantile(q) == pytest.approx(
+                exact, rel=HIST_REL_ERR), (q,)
+        # the mean is exact (tracked as a sum), not bucket-quantized;
+        # p50 also sits near the reservoir's interpolated read
+        pr, ph = res.percentiles(), hist.percentiles()
+        assert ph["avg"] == pytest.approx(pr["avg"], rel=1e-12)
+        if len(sample) >= 100:   # interpolation ~ nth-element at scale
+            assert ph["p50"] == pytest.approx(pr["p50"], rel=0.10)
+
+
+def test_histogram_merge_is_exact_and_associative():
+    """Cross-shard/window merge: bucket counts add, so any grouping of
+    merges equals the single histogram of the concatenated stream —
+    the property reservoir downsampling cannot give."""
+    rng = np.random.default_rng(0)
+    parts = [rng.lognormal(4.0, 1.5, n) for n in (400, 7, 1300)]
+
+    def h(arrs):
+        out = LatencyHistogram()
+        for a in arrs:
+            out.add(a)
+        return out
+
+    whole = h(parts)
+    left = h(parts[:1]).merge(h(parts[1:2])).merge(h(parts[2:3]))
+    right = h(parts[:1]).merge(h(parts[1:2]).merge(h(parts[2:3])))
+    for m in (left, right):
+        np.testing.assert_array_equal(m.counts, whole.counts)
+        assert m.n == whole.n
+        assert m.sum_us == pytest.approx(whole.sum_us)
+        assert m.percentiles() == whole.percentiles()
+
+
+def test_histogram_totality_matches_reservoir_contract():
+    # empty -> zeros, never NaN
+    assert LatencyHistogram().percentiles() == dict(avg=0.0, p50=0.0,
+                                                    p99=0.0, p999=0.0)
+    # n == 1 -> every percentile is the same defined value, within the
+    # bucket bound of the sample
+    h1 = LatencyHistogram()
+    h1.add(42.5)
+    p = h1.percentiles()
+    assert p["p50"] == p["p99"] == p["p999"]
+    assert p["p50"] == pytest.approx(42.5, rel=HIST_REL_ERR)
+    assert p["avg"] == 42.5
+    # non-finite samples are excluded and counted, not poisoning
+    h2 = LatencyHistogram()
+    h2.add(np.array([1.0, np.nan, 2.0, np.inf, 3.0]))
+    assert h2.n == 3 and h2.dropped_nonfinite == 2
+    assert all(np.isfinite(v) for v in h2.percentiles().values())
+    h3 = LatencyHistogram()
+    h3.add(np.array([np.nan, np.nan]))
+    assert h3.percentiles() == dict(avg=0.0, p50=0.0, p99=0.0, p999=0.0)
+    # zero/negative and out-of-range samples clamp to edge buckets
+    h4 = LatencyHistogram()
+    h4.add(np.array([0.0, -5.0, 1e30]))
+    assert h4.n == 3
+    assert h4.counts[0] == 2 and h4.counts[-1] == 1
+
+
+def test_histogram_serialization_roundtrip():
+    h = LatencyHistogram()
+    h.add(np.geomspace(0.5, 2e4, 257))
+    h.add(np.array([np.inf]))
+    d = h.to_dict()
+    assert d["schema"] == LatencyHistogram.SCHEMA
+    assert d["n"] == 257 and d["dropped_nonfinite"] == 1
+    assert d["p50_us"] == round(h.quantile(0.5), 2)
+    # sparse: only non-zero buckets serialized
+    assert all(int(c) > 0 for c in d["buckets"].values())
+    h2 = LatencyHistogram.from_dict(d)
+    np.testing.assert_array_equal(h2.counts, h.counts)
+    assert h2.n == h.n and h2.dropped_nonfinite == h.dropped_nonfinite
+    p, p2 = h.percentiles(), h2.percentiles()
+    assert p2["p50"] == p["p50"] and p2["p999"] == p["p999"]
+    # sum_us serializes rounded to 1e-3 µs — avg roundtrips to that
+    assert p2["avg"] == pytest.approx(p["avg"], abs=1e-3)
+
+
+def test_reservoir_carries_exact_histogram_past_cap():
+    lat = LatencyReservoir(cap=64, seed=0)
+    lat.add(np.full(1000, 5.0))
+    # the reservoir downsampled; the histogram counted everything
+    assert lat.n_kept == 64 and lat.hist.n == 1000
+    assert lat.hist.percentiles()["p50"] == pytest.approx(
+        5.0, rel=HIST_REL_ERR)

@@ -11,6 +11,8 @@ Usage:
     python tools/dintmon.py summarize RUN.jsonl --json     # one JSON line
     python tools/dintmon.py diff A.jsonl B.jsonl           # counter deltas
     python tools/dintmon.py export-trace RUN.jsonl -o trace.json
+    python tools/dintmon.py export-trace RUN.jsonl -o merged.json \
+        --merge trace_dir/          # counters + device ops, one timeline
     python tools/dintmon.py describe                       # the registry
 
 `export-trace` writes the Chrome trace-event format — load it in
@@ -123,12 +125,16 @@ def cmd_diff(args) -> int:
 
 
 def cmd_export_trace(args) -> int:
-    n = tr.export_chrome_trace(args.file, args.out)
-    out = {"metric": "dintmon_export", "events": n, "out": args.out}
+    n = tr.export_chrome_trace(args.file, args.out,
+                               merge_trace=args.merge,
+                               offset_us=args.offset_us)
+    out = {"metric": "dintmon_export", "events": n, "out": args.out,
+           "merged": args.merge}
     if args.json:
         print(json.dumps(out), flush=True)
     else:
-        print(f"wrote {n} trace events -> {args.out} "
+        merged = f" (merged with {args.merge})" if args.merge else ""
+        print(f"wrote {n} trace events -> {args.out}{merged} "
               "(open in chrome://tracing or ui.perfetto.dev)")
     return 0
 
@@ -175,6 +181,14 @@ def main(argv=None) -> int:
                        help="JSONL stream -> Chrome trace-event JSON")
     p.add_argument("file")
     p.add_argument("-o", "--out", required=True)
+    p.add_argument("--merge", default=None, metavar="PROFILER_TRACE",
+                   help="jax.profiler Chrome trace (file or trace dir) to "
+                        "merge onto the same timeline: the counter wave "
+                        "slices and the device ops land in one Perfetto "
+                        "view, aligned on a shared clock offset (first "
+                        "wave pinned to the trace's earliest device op)")
+    p.add_argument("--offset-us", type=float, default=None,
+                   help="explicit dintmon->profiler clock offset override")
     p.add_argument("--json", action="store_true")
     p.set_defaults(fn=cmd_export_trace)
 

@@ -30,21 +30,39 @@ timeout 1500 python tools/profile_pallas_hbm.py --compare --hot-frac 0.04 \
 tail -3 pallas_hot_ab.log
 
 echo "=== stage 2: baseline bench (hot tier off) ==="
-DINT_BENCH_PROFILE=1 DINT_MONITOR=1 timeout 2200 python bench.py \
+DINT_BENCH_PROFILE=1 DINT_MONITOR=1 DINT_BENCH_TRACE_DIR=trace_r10_off \
+    timeout 2200 python bench.py \
     > bench_hot_off.json 2> bench_hot_off_stderr.log
 tail -1 bench_hot_off.json
 
 echo "=== stage 3: hot-set bench (XLA partition route) ==="
 DINT_USE_HOTSET=1 DINT_BENCH_PROFILE=1 DINT_MONITOR=1 \
-    timeout 2200 python bench.py \
+    DINT_BENCH_TRACE_DIR=trace_r10_xla timeout 2200 python bench.py \
     > bench_hot_xla.json 2> bench_hot_xla_stderr.log
 tail -1 bench_hot_xla.json
 
 echo "=== stage 4: hot-set bench (VMEM kernels) — the tentpole measurement ==="
 DINT_USE_HOTSET=1 DINT_USE_PALLAS=1 DINT_BENCH_PROFILE=1 DINT_MONITOR=1 \
-    timeout 2200 python bench.py \
+    DINT_BENCH_TRACE_DIR=trace_r10_pallas timeout 2200 python bench.py \
     > bench_hot_pallas.json 2> bench_hot_pallas_stderr.log
 tail -1 bench_hot_pallas.json
+
+echo "=== stage 4b: dintscope per-wave attribution + regression gate ==="
+# pre-attributed A/B: the per-wave ledger shows WHERE the VMEM mirror
+# moved time (smallbank read/install waves) and the diff gate names any
+# wave the hot tier regressed (exit 1 recorded, not fatal — it feeds the
+# decision rule above)
+for t in off xla pallas; do
+    if [ -d "trace_r10_${t}" ]; then
+        python tools/dintscope.py report "trace_r10_${t}" \
+            --geom w=8192 k=4 l=3 vw=10 --json \
+            > "dintscope_r10_${t}.json" 2>> dintscope_r10.log || true
+    fi
+done
+if [ -s dintscope_r10_off.json ] && [ -s dintscope_r10_pallas.json ]; then
+    python tools/dintscope.py diff dintscope_r10_off.json \
+        dintscope_r10_pallas.json | tail -8 || true
+fi
 
 echo "=== stage 5: skew sweep (hot tier on vs off at each skew) ==="
 timeout 2400 python exp.py --only smallbank_skew --window 5 \
