@@ -294,7 +294,7 @@ class _Analyzer:
             if sub is not None and len(sub.invars) == len(eqn.invars):
                 return self._call(eqn, sub, path + (prim,), in_pallas)
         if prim == "pallas_call":
-            return self._pallas_call(eqn, path)
+            return self._pallas_call(eqn, defs, path)
         if prim in _CALL_PRIMS:
             sub = _sub_jaxpr(eqn.params.get("jaxpr")
                              or eqn.params.get("call_jaxpr"))
@@ -389,15 +389,25 @@ class _Analyzer:
 
     # -- pallas -----------------------------------------------------------
 
-    def _pallas_lock_kernel(self, eqn) -> bool:
-        """The fused lock pass (ops/pallas_gather.lock_arbitrate): named
-        after its kernel, or recognizable as an aliased kernel whose body
-        unpacks stamps with shifts (the gather kernel has neither)."""
+    @staticmethod
+    def _kernel_name(eqn) -> str:
         name = ""
         for k in ("name", "name_and_src_info", "debug"):
             v = eqn.params.get(k)
             if v is not None:
                 name += str(v)
+        return name
+
+    def _pallas_lock_kernel(self, eqn) -> bool:
+        """The fused lock pass (ops/pallas_gather.lock_arbitrate): named
+        after its kernel, or recognizable as an aliased kernel whose body
+        unpacks stamps with shifts (the gather kernel has neither). The
+        round-12 stream kernels are explicitly NOT lock kernels — their
+        aliased outputs are installs (and lock_validate has a dedicated
+        handler before this one runs)."""
+        name = self._kernel_name(eqn)
+        if "scatter_streams" in name or "gather_streams" in name:
+            return False
         if "arbitrate" in name:
             return True
         aliases = eqn.params.get("input_output_aliases") or ()
@@ -420,7 +430,83 @@ class _Analyzer:
                         stack.append(s)
         return False
 
-    def _pallas_call(self, eqn, path):
+    def _pallas_lock_validate(self, eqn, path):
+        """The round-12 lock_validate megakernel (ops/pallas_gather):
+        operands = 6 scalar-prefetch args (vidx, vv1, ridx, rows, active,
+        step) + meta + arb (aliased to out 0); outputs = (arb', grant,
+        vbad, rmeta). The kernel is BOTH the lock-arbitration RMW and the
+        OCC validate read, so its outputs carry split roles: the arb-side
+        outputs keep the lock character (grant seeds LOCK_WIN exactly
+        like lock_arbitrate's) while the meta-read outputs are table
+        reads — and the in-kernel verdict means the validate compare the
+        protocol pass needs no longer exists as an XLA eqn, so vbad
+        seeds VALIDATED here directly."""
+        merged = set()
+        for a in eqn.invars:
+            merged |= self.facts(a)
+        merged.discard(STATE)
+        aliases = dict(eqn.params.get("input_output_aliases") or {})
+        state_in = [STATE in self.pfacts(a) for a in eqn.invars]
+        if not self.protocol_phase:
+            arb_side = (merged | {ARB})
+            read_side = (merged - {ARB}) | (
+                {TBL_READ} if any(state_in) else set())
+            for oi, ov in enumerate(eqn.outvars):
+                fs = set(arb_side if oi in (0, 1) else read_side)
+                for ii, out_idx in aliases.items():
+                    if int(out_idx) == oi and 0 <= int(ii) < len(state_in) \
+                            and state_in[int(ii)]:
+                        fs.add(STATE)   # in-place arb RMW
+                self.bind(ov, fs)
+            return
+        if self.recording:
+            self._pallas[id(eqn)] = SeedSite(
+                LOCK_WIN, "pallas_call", site_of(eqn), path)
+            self._seeds[(VALIDATED, id(eqn))] = SeedSite(
+                VALIDATED, "pallas_call", site_of(eqn), path)
+        for oi, ov in enumerate(eqn.outvars):
+            fs = set(merged)
+            if oi in (0, 1):
+                fs.add(LOCK_WIN)
+            if oi == 2:
+                fs.add(VALIDATED)
+            self.bind(ov, fs)
+
+    def _record_scatter_streams(self, eqn, defs, path):
+        """Record the round-12 install_log megakernel's aliased streams
+        as synthetic ScatterRecs — one per (idx, vals, tab) triple — so
+        the protocol pass sees the fused installs on the same terms as
+        the unfused 1-D unique-index scatters they replace. Operand
+        layout (ops/pallas_gather.scatter_streams): S scalar-prefetch
+        index arrays, S value arrays, S aliased tables; masked lanes ride
+        idx = -1, so the mask facts arrive via index_facts exactly like
+        the unfused `where(mask, idx, oob)` routing."""
+        aliases = dict(eqn.params.get("input_output_aliases") or {})
+        s_n = len(aliases)
+        ins = eqn.invars
+        if not s_n or len(ins) < 3 * s_n:
+            return
+        for s in range(s_n):
+            idx, vals, tab = ins[s], ins[s_n + s], ins[2 * s_n + s]
+            self._scatters[(id(eqn), s)] = ScatterRec(
+                prim="scatter", site=site_of(eqn), path=path,
+                in_pallas=False,
+                is_state=STATE in self.pfacts(tab),
+                operand_facts=frozenset(self.allfacts(tab)),
+                index_facts=frozenset(self.allfacts(idx)),
+                update_facts=frozenset(self.allfacts(vals)),
+                root=self._operand_root(tab, defs),
+                idx_nonconst=not self.is_const(idx))
+
+    def _pallas_call(self, eqn, defs, path):
+        name = self._kernel_name(eqn)
+        if "lock_validate" in name:
+            return self._pallas_lock_validate(eqn, path)
+        if "scatter_streams" in name and self.recording:
+            self._record_scatter_streams(eqn, defs, path)
+            # fall through: the generic aliased-non-lock transfer below
+            # already binds the outputs correctly (ARB killed, STATE
+            # forwarded through the aliases)
         merged = set()
         for a in eqn.invars:
             merged |= self.facts(a)

@@ -97,18 +97,25 @@ def _mesh(n: int):
 # ------------------------------------------------------------ dense TATP
 
 
-def _tatp_dense(name: str, use_pallas: bool,
-                monitor: bool = False) -> TargetTrace:
+def _tatp_dense(name: str, use_pallas: bool, monitor: bool = False,
+                use_hotset: bool = False,
+                use_fused: bool = False) -> TargetTrace:
     from ..engines import tatp_dense as td
     from .. import monitor as mn
     run, init, _ = td.build_pipelined_runner(_N_SUB, w=_W, val_words=_VW,
                                              cohorts_per_block=_BLK,
                                              use_pallas=use_pallas,
+                                             use_hotset=use_hotset,
+                                             use_fused=use_fused,
                                              monitor=monitor)
-    carry = _abstract(lambda: (td.create(_N_SUB, val_words=_VW,
-                                         log_capacity=_LOGCAP),
-                               td.empty_ctx(_W), td.empty_ctx(_W))
-                      + ((mn.create(),) if monitor else ()))
+    if use_hotset:
+        carry = _abstract(lambda: init(td.create(_N_SUB, val_words=_VW,
+                                                 log_capacity=_LOGCAP)))
+    else:
+        carry = _abstract(lambda: (td.create(_N_SUB, val_words=_VW,
+                                             log_capacity=_LOGCAP),
+                                   td.empty_ctx(_W), td.empty_ctx(_W))
+                          + ((mn.create(),) if monitor else ()))
     return trace_target(name, run, (carry, _key_aval()))
 
 
@@ -161,12 +168,14 @@ def _t_tatp_dense_drain() -> TargetTrace:
 
 
 def _sb_dense(name: str, use_pallas: bool, monitor: bool = False,
-              use_hotset: bool = False) -> TargetTrace:
+              use_hotset: bool = False,
+              use_fused: bool = False) -> TargetTrace:
     from ..engines import smallbank_dense as sd
     run, init, _ = sd.build_pipelined_runner(_N_ACCT, w=_W,
                                              cohorts_per_block=_BLK,
                                              use_pallas=use_pallas,
                                              use_hotset=use_hotset,
+                                             use_fused=use_fused,
                                              monitor=monitor)
     # carry via the runner's own init so the @hot variants get the hot
     # mirror attached exactly as production does
@@ -332,13 +341,14 @@ def _t_sharded_sb() -> TargetTrace:
 # --------------------------------------------------- dense multi-chip
 
 
-def _dense_sharded(name: str, use_pallas: bool,
-                   monitor: bool = False) -> TargetTrace:
+def _dense_sharded(name: str, use_pallas: bool, monitor: bool = False,
+                   use_fused: bool = False) -> TargetTrace:
     from ..parallel import dense_sharded as ds
     mesh = _mesh(_MESH_SHARDS)
     run, init, _ = ds.build_sharded_pipelined_runner(
         mesh, _MESH_SHARDS, _N_SUB * _MESH_SHARDS, w=_W, val_words=_VW,
-        cohorts_per_block=_BLK, use_pallas=use_pallas, monitor=monitor)
+        cohorts_per_block=_BLK, use_pallas=use_pallas,
+        use_fused=use_fused, monitor=monitor)
     carry = _abstract(lambda: init(ds.create_sharded(
         mesh, _MESH_SHARDS, _N_SUB * _MESH_SHARDS, val_words=_VW,
         log_capacity=_LOGCAP)))
@@ -371,13 +381,14 @@ def _t_dense_sharded_mon() -> TargetTrace:
 
 
 def _dense_sharded_sb(name: str, monitor: bool = False,
-                      use_hotset: bool = False) -> TargetTrace:
+                      use_hotset: bool = False,
+                      use_fused: bool = False) -> TargetTrace:
     from ..parallel import dense_sharded_sb as dsb
     mesh = _mesh(_MESH_SHARDS)
     run, init, _ = dsb.build_sharded_sb_runner(
         mesh, _MESH_SHARDS, _N_ACCT * _MESH_SHARDS, w=_W,
         cohorts_per_block=_BLK, use_pallas=False, use_hotset=use_hotset,
-        monitor=monitor)
+        use_fused=use_fused, monitor=monitor)
     carry = _abstract(lambda: init(dsb.create_sharded_sb(
         mesh, _MESH_SHARDS, _N_ACCT * _MESH_SHARDS)))
     return trace_target(name, run, (carry, _key_aval()),
@@ -442,6 +453,125 @@ def _t_tatp_dense_hot_pl() -> TargetTrace:
                                              log_capacity=_LOGCAP)))
     return trace_target("tatp_dense/block@hot+pallas", run,
                         (carry, _key_aval()))
+
+
+# -------------------------------------------------- round-12 megakernels
+# Every engine that can dispatch the fused wave pairs (DINT_USE_FUSED=1)
+# re-registers here with ``use_fused=True`` forced, so the protocol pass
+# proves lock-dominates-write / validate-before-install THROUGH the
+# lock_validate and install_log megakernels (dataflow.py recognizes them
+# by kernel name: lock_validate seeds LOCK_WIN + VALIDATED on its own
+# outputs, scatter_streams records one synthetic install per aliased
+# stream). On CPU the kernels trace in interpret mode like @pallas.
+
+
+@register_target("tatp_dense/block@fused",
+                 "dense TATP with the round-12 megakernels: lock+validate "
+                 "and install+log-append each a single dispatch",
+                 protocol=('certified', 'occ'))
+def _t_tatp_dense_fused() -> TargetTrace:
+    return _tatp_dense("tatp_dense/block@fused", use_pallas=False,
+                       use_fused=True)
+
+
+@register_target("tatp_dense/block@fused+hot",
+                 "dense TATP: megakernels over the dintcache row-prefix "
+                 "partition (lock_validate keeps the hot_n VMEM arb "
+                 "prefix; install_log scatters the hot mirrors as extra "
+                 "aliased streams)",
+                 protocol=('certified', 'occ'))
+def _t_tatp_dense_fused_hot() -> TargetTrace:
+    return _tatp_dense("tatp_dense/block@fused+hot", use_pallas=False,
+                       use_hotset=True, use_fused=True)
+
+
+@register_target("tatp_dense/block@fused+mon",
+                 "dense TATP: megakernels + counter plane (fused_dispatch "
+                 "bump and the pre-kernel held-stamp read both certified)",
+                 protocol=('certified', 'occ'))
+def _t_tatp_dense_fused_mon() -> TargetTrace:
+    return _tatp_dense("tatp_dense/block@fused+mon", use_pallas=False,
+                       use_fused=True, monitor=True)
+
+
+@register_target("smallbank_dense/block@fused",
+                 "dense SmallBank with the round-12 megakernels (gather "
+                 "streams feed the XLA scatter-min arbitration; install + "
+                 "log ride one scatter_streams dispatch)",
+                 protocol=('certified',))
+def _t_sb_dense_fused() -> TargetTrace:
+    return _sb_dense("smallbank_dense/block@fused", use_pallas=False,
+                     use_fused=True)
+
+
+@register_target("smallbank_dense/block@fused+hot",
+                 "dense SmallBank: megakernels + dintcache mirror (fused "
+                 "gathers read main arrays by the mirror invariant; the "
+                 "hot mirror is a third aliased install stream)",
+                 protocol=('certified',))
+def _t_sb_dense_fused_hot() -> TargetTrace:
+    return _sb_dense("smallbank_dense/block@fused+hot", use_pallas=False,
+                     use_hotset=True, use_fused=True)
+
+
+@register_target("smallbank_dense/block@fused+mon",
+                 "dense SmallBank: megakernels + counter plane",
+                 protocol=('certified',))
+def _t_sb_dense_fused_mon() -> TargetTrace:
+    return _sb_dense("smallbank_dense/block@fused+mon", use_pallas=False,
+                     use_fused=True, monitor=True)
+
+
+@register_target("dense_sharded/block@fused",
+                 "multi-chip dense TATP with the megakernels inside the "
+                 "shard_map body (replicate fan-out stays ppermute + XLA "
+                 "so REPL_PUSHED provenance is unchanged)",
+                 protocol=('certified', 'occ', 'replicated'))
+def _t_dense_sharded_fused() -> TargetTrace:
+    return _dense_sharded("dense_sharded/block@fused", use_pallas=False,
+                          use_fused=True)
+
+
+# no dense_sharded/block@fused+hot: build_sharded_pipelined_runner has no
+# hot-set partition (the TATP sharded path shards by subscriber, so the
+# skewed prefix never concentrates on one device — see PERF.md round 10)
+
+
+@register_target("dense_sharded/block@fused+mon",
+                 "multi-chip dense TATP: megakernels + per-device counter "
+                 "planes",
+                 protocol=('certified', 'occ', 'replicated'))
+def _t_dense_sharded_fused_mon() -> TargetTrace:
+    return _dense_sharded("dense_sharded/block@fused+mon",
+                          use_pallas=False, use_fused=True, monitor=True)
+
+
+@register_target("dense_sharded_sb/block@fused",
+                 "multi-chip dense SmallBank: owner-routed step with the "
+                 "megakernels (all_to_all routing and the replica "
+                 "ppermute stay XLA)",
+                 protocol=('certified', 'replicated'))
+def _t_dense_sharded_sb_fused() -> TargetTrace:
+    return _dense_sharded_sb("dense_sharded_sb/block@fused",
+                             use_fused=True)
+
+
+@register_target("dense_sharded_sb/block@fused+hot",
+                 "multi-chip dense SmallBank: megakernels + per-device "
+                 "dintcache mirrors",
+                 protocol=('certified', 'replicated'))
+def _t_dense_sharded_sb_fused_hot() -> TargetTrace:
+    return _dense_sharded_sb("dense_sharded_sb/block@fused+hot",
+                             use_hotset=True, use_fused=True)
+
+
+@register_target("dense_sharded_sb/block@fused+mon",
+                 "multi-chip dense SmallBank: megakernels + per-device "
+                 "counter planes",
+                 protocol=('certified', 'replicated'))
+def _t_dense_sharded_sb_fused_mon() -> TargetTrace:
+    return _dense_sharded_sb("dense_sharded_sb/block@fused+mon",
+                             use_fused=True, monitor=True)
 
 
 # ----------------------------------------------------------------- API

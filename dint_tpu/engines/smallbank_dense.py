@@ -236,6 +236,7 @@ def _stats_of(c: BankCtx):
 def pipe_step(db: DenseBank, c1: BankCtx, key, *, w: int, n_accounts: int,
               gen_new: bool = True, hot_frac=None, hot_prob=None, mix=None,
               use_pallas: bool = False, use_hotset: bool = False,
+              use_fused: bool = False,
               counters: mon.Counters | None = None):
     """One fused device step: wave 1 of a NEW cohort acquires against c1's
     STILL-HELD stamps (stamp == step-1), then wave 2 installs c1's writes.
@@ -259,6 +260,18 @@ def pipe_step(db: DenseBank, c1: BankCtx, key, *, w: int, n_accounts: int,
     skew this converts the dominant random-HBM row DMAs into VMEM
     accesses; outputs stay bit-identical to the default path (pinned in
     tests/test_hotset.py).
+
+    ``use_fused`` (static; OFF by default) swallows the step's wave pairs
+    into the round-12 megakernels: the held-stamp gathers + the fused
+    balance read become gather streams of ONE lock_validate dispatch
+    (the scatter-min arbitration and grant compares stay XLA — LOCK_WIN
+    still seeds at the compare), and the balance install + log x3 append
+    (+ hot-mirror write-through) become scatter streams of ONE
+    install_log dispatch. Bit-identical to the unfused path
+    (tests/test_fused_ops.py); independent of ``use_pallas``. With
+    ``use_hotset`` the fused gathers read the main arrays directly
+    (bit-identical by the mirror invariant) while installs keep the
+    write-through, so the mirror stays coherent.
 
     ``counters`` (monitor.Counters | None): the dintmon counter plane —
     txn outcomes from c1's completing stats, S/X arbitration won-vs-lost
@@ -308,6 +321,18 @@ def pipe_step(db: DenseBank, c1: BankCtx, key, *, w: int, n_accounts: int,
         hot_lane = (active & (l_ac < hn)).reshape(-1)
         midx = jnp.where(hot_lane, (l_tb * hn + l_ac).reshape(-1), -1)
 
+    if use_fused:
+        # lock_validate megakernel: both held-stamp gathers AND the wave-1
+        # balance read ride ONE gather_streams dispatch. All three read
+        # pre-install state (the balance rows c1 installs below were
+        # X-stamped by c1, so this cohort never granted them), and the
+        # fused route reads the main arrays directly — bit-identical to
+        # the hot-partitioned serving by the mirror invariant
+        with waves.scope("smallbank_dense", "lock_validate"):
+            hx_raw, hs_raw, fused_bal = pg.gather_streams(
+                (db.x_step, db.s_step, db.bal),
+                (slot, slot, flat_rows), (1, 1, 1))
+
     with waves.scope("smallbank_dense", "lock"):
         first_x = jnp.full((h,), BIG, I32).at[
             jnp.where(is_x_lane, slot, h)].min(lane, mode="drop")
@@ -315,7 +340,10 @@ def pipe_step(db: DenseBank, c1: BankCtx, key, *, w: int, n_accounts: int,
             jnp.where(is_s_lane, slot, h)].min(lane, mode="drop")
         # held = stamped by the previous step's cohort (released implicitly
         # one step later; acquire-before-release semantics preserved)
-        if stamp_hot:
+        if use_fused:
+            held_x = hx_raw == t - 1
+            held_s = hs_raw == t - 1
+        elif stamp_hot:
             held_x = pg.hot_gather(db.x_step, db.hot_x, slot, midx, 1,
                                    use_pallas=use_pallas) == t - 1
             held_s = pg.hot_gather(db.s_step, db.hot_s, slot, midx, 1,
@@ -355,7 +383,9 @@ def pipe_step(db: DenseBank, c1: BankCtx, key, *, w: int, n_accounts: int,
     # fused reads from the pre-install table: rows c1 installs below were
     # X-stamped by c1, so this cohort never granted (or consumed) them
     with waves.scope("smallbank_dense", "read"):
-        if use_hotset:
+        if use_fused:
+            raw_bal = fused_bal     # already gathered in lock_validate
+        elif use_hotset:
             raw_bal = pg.hot_gather(db.bal, db.hot_bal, flat_rows, midx, 1,
                                     use_pallas=use_pallas)
         else:
@@ -383,11 +413,47 @@ def pipe_step(db: DenseBank, c1: BankCtx, key, *, w: int, n_accounts: int,
     # the S/X grants (lock-dominates-write), and the x_step/s_step writes
     # stamp the step scalar — the expiring-lock witness that discharges
     # abort-implies-unlock for this engine's release-free design.
-    with waves.scope("smallbank_dense", "install"):
+    with waves.scope("smallbank_dense",
+                     "install_log" if use_fused else "install"):
         dwf = c1.do_write.reshape(-1)
         wrows = jnp.where(dwf, c1.rows.reshape(-1), oob)       # [wL]
         newbal = c1.nw.reshape(-1)
-        if use_hotset:
+        if use_fused:
+            # install_log megakernel: balance install, log x3 append, and
+            # (hotset) the mirror write-through as masked row-scatter
+            # streams of ONE dispatch. The log plan is the exact
+            # append_rep plan (tables/log.plan_rep), so ring bytes match
+            # the unfused path bit for bit
+            newval = jnp.zeros((wrows.shape[0], VW), U32)
+            newval = newval.at[:, 0].set(newbal.astype(U32))
+            newval = newval.at[:, 1].set(
+                jnp.where(dwf, U32(MAGIC), U32(0)))
+            zero = jnp.zeros_like(newbal, U32)
+            stepv = jnp.broadcast_to(t, newbal.shape)
+            lflat, entry3, lane_counts = logring.plan_rep(
+                db.log, dwf, c1.tbl.reshape(-1), jnp.zeros_like(newbal),
+                zero, c1.acc.reshape(-1).astype(U32), stepv, newval)
+            widx = jnp.where(dwf, c1.rows.reshape(-1), -1)
+            tabs = [db.bal, db.log.entries.reshape(-1)]
+            idxs = [widx, lflat]
+            vals = [newbal.astype(U32), entry3.reshape(-1)]
+            vws = [1, db.log.entries.shape[1]]
+            if use_hotset:
+                w_acc = c1.acc.reshape(-1)
+                w_midx = jnp.where(dwf & (w_acc < hn),
+                                   c1.tbl.reshape(-1) * hn + w_acc, -1)
+                tabs += [db.hot_bal]
+                idxs += [w_midx]
+                vals += [newbal.astype(U32)]
+                vws += [1]
+            outs = pg.scatter_streams(tuple(tabs), tuple(idxs),
+                                      tuple(vals), tuple(vws))
+            bal_new = outs[0]
+            logs = db.log.replace(
+                entries=outs[1].reshape(db.log.entries.shape),
+                head=db.log.head + lane_counts)
+            hot_bal = outs[2] if use_hotset else db.hot_bal
+        elif use_hotset:
             # partitioned install: the full table AND the hot mirror take
             # the write (one fused kernel on the pallas route, a double
             # 1-D unique-index scatter on XLA) — the write-through that
@@ -403,18 +469,20 @@ def pipe_step(db: DenseBank, c1: BankCtx, key, *, w: int, n_accounts: int,
             bal_new = db.bal.at[wrows].set(newbal.astype(U32), mode="drop",
                                            unique_indices=True)
 
-    with waves.scope("smallbank_dense", "log_append"):
-        newval = jnp.zeros((wrows.shape[0], VW), U32)
-        newval = newval.at[:, 0].set(newbal.astype(U32))
-        newval = newval.at[:, 1].set(jnp.where(dwf, U32(MAGIC), U32(0)))
-        zero = jnp.zeros_like(newbal, U32)
-        # log ver = step index: monotonic per row (one X-writer per row
-        # per step), which is all recovery's max-ver-per-row rule needs
-        stepv = jnp.broadcast_to(t, newbal.shape)
-        logs = logring.append_rep(db.log, dwf, c1.tbl.reshape(-1),
-                                  jnp.zeros_like(newbal), zero,
-                                  c1.acc.reshape(-1).astype(U32), stepv,
-                                  newval)
+    if not use_fused:
+        with waves.scope("smallbank_dense", "log_append"):
+            newval = jnp.zeros((wrows.shape[0], VW), U32)
+            newval = newval.at[:, 0].set(newbal.astype(U32))
+            newval = newval.at[:, 1].set(jnp.where(dwf, U32(MAGIC),
+                                                   U32(0)))
+            zero = jnp.zeros_like(newbal, U32)
+            # log ver = step index: monotonic per row (one X-writer per
+            # row per step), all recovery's max-ver-per-row rule needs
+            stepv = jnp.broadcast_to(t, newbal.shape)
+            logs = logring.append_rep(db.log, dwf, c1.tbl.reshape(-1),
+                                      jnp.zeros_like(newbal), zero,
+                                      c1.acc.reshape(-1).astype(U32),
+                                      stepv, newval)
 
     db = db.replace(bal=bal_new, x_step=x_step, s_step=s_step,
                     step=t + 1, log=logs, hot_bal=hot_bal,
@@ -429,8 +497,10 @@ def pipe_step(db: DenseBank, c1: BankCtx, key, *, w: int, n_accounts: int,
             # partition accounting: every hot-partitioned gather serves
             # (midx >= 0) lanes from the mirror and the rest via cold row
             # DMAs; the mirror refresh is one bulk DMA per pallas gather
-            # invocation (0 on the XLA partition route)
-            n_g = 1 + (2 if stamp_hot else 0)
+            # invocation (0 on the XLA partition route). The fused route
+            # reads the main arrays directly (no gather is partitioned),
+            # so its partition counters are structurally zero
+            n_g = 0 if use_fused else 1 + (2 if stamp_hot else 0)
             hits = (midx >= 0).sum(dtype=I32)
             hot_ctrs = {
                 mon.CTR_HOT_HITS: n_g * hits,
@@ -455,6 +525,7 @@ def pipe_step(db: DenseBank, c1: BankCtx, key, *, w: int, n_accounts: int,
             mon.CTR_LOG_APPENDS: dwf.sum(dtype=I32),
             (mon.CTR_DISPATCH_PALLAS if use_pallas
              else mon.CTR_DISPATCH_XLA): 1,
+            **({mon.CTR_FUSED_DISPATCH: 1} if use_fused else {}),
         })
         counters = mon.gauge_max(
             counters, {mon.CTR_RING_HWM: logs.head.max()})
@@ -465,7 +536,8 @@ def pipe_step(db: DenseBank, c1: BankCtx, key, *, w: int, n_accounts: int,
 def build_pipelined_runner(n_accounts: int, w: int = 8192,
                            cohorts_per_block: int = 8, hot_frac=None,
                            hot_prob=None, mix=None, use_pallas=None,
-                           use_hotset=None, monitor: bool = False):
+                           use_hotset=None, use_fused=None,
+                           monitor: bool = False):
     """jit(scan(pipe_step)) over carry (db, c1). Returns (run, init, drain):
       run(carry, key) -> (carry', stats [cohorts_per_block, N_STATS])
       init(db)        -> carry with one bootstrap cohort in flight
@@ -482,6 +554,12 @@ def build_pipelined_runner(n_accounts: int, w: int = 8192,
     A Mosaic rejection of the hot kernels degrades the serving backend to
     the XLA index-compare partition, never the split itself.
 
+    ``use_fused``: None = honor DINT_USE_FUSED env; True/False forces.
+    Routes the step through the round-12 megakernels (gather-stream
+    lock_validate + scatter-stream install_log) after probing them at
+    this runner's geometry; probe failure degrades to the unfused path
+    with a logged warning (pg.resolve_use_fused).
+
     ``monitor``: thread the dintmon counter plane — the carry grows a
     trailing monitor.Counters leaf and drain returns (db, stats,
     counters); off (default) = contract and jaxpr unchanged.
@@ -495,8 +573,16 @@ def build_pipelined_runner(n_accounts: int, w: int = 8192,
         hot_n = max(1, min(int(n_accounts * frac), n_accounts))
         if use_pallas and not pg.hot_kernels_available(n_idx=w * L):
             use_pallas = False      # partition stays; XLA serves it
+    ew3 = N_SHARDS * (logring.HDR_WORDS + VW)
+    scat_geoms = ((w * L, 1), (w * L, ew3))
+    if use_hotset:
+        scat_geoms = scat_geoms + ((w * L, 1),)
+    use_fused = pg.resolve_use_fused(
+        use_fused,
+        gathers=((w * L, 1), (w * L, 1), (w * L, 1)),
+        scatters=scat_geoms)
     kw = dict(w=w, n_accounts=n_accounts, use_pallas=use_pallas,
-              use_hotset=use_hotset)
+              use_hotset=use_hotset, use_fused=use_fused)
     kw_gen = dict(kw, hot_frac=hot_frac, hot_prob=hot_prob, mix=mix)
 
     def step_mon(db, c1, key, cnt, **skw):

@@ -397,6 +397,7 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
               n_sub: int, val_words: int, gen_new: bool = True, mix=None,
               emit_installs: bool = False, check_magic: bool = True,
               use_pallas: bool = False, use_hotset: bool = False,
+              use_fused: bool = False,
               counters: mon.Counters | None = None):
     """One fused device step: commit wave of c2, validate wave of c1, and
     read+lock wave of a NEW cohort — ordered commits -> reads -> locks per
@@ -422,6 +423,17 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
     installs, and caches the arb prefix in VMEM inside the fused lock
     pass. Bit-identical to the default path (tests/test_hotset.py);
     exposed for skewed-TATP experiments.
+
+    ``use_fused`` (static; OFF by default) swallows wave pairs into the
+    round-12 megakernels: lock arbitration + OCC validate-gather run as
+    ONE lock_validate dispatch, and the install scatter + replication-log
+    append run as ONE install_log scatter_streams dispatch — shortening
+    the chain from ~6 dispatches to ~4. Bit-identical to the unfused path
+    (tests/test_fused_ops.py); independent of ``use_pallas`` (the magic
+    gather still dispatches by use_pallas) and composes with
+    ``use_hotset`` (arb prefix stays VMEM-resident inside lock_validate;
+    installs write through the mirrors as extra streams). Builders
+    resolve via pg.resolve_use_fused (probe-and-degrade).
 
     ``counters`` (a monitor.Counters, or None = off): the device-resident
     counter plane. When threaded, the step bumps the dintmon registry
@@ -449,7 +461,8 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
     # stay data-dependent on c2.alive — the chain grant -> alive ->
     # ~changed -> wmask is what proves lock-dominates-write and
     # validate-before-install; severing it fails the tier-1 gate.
-    with waves.scope("tatp_dense", "install"):
+    with waves.scope("tatp_dense",
+                     "install_log" if use_fused else "install"):
         do_write = c2.ws_active & c2.alive[:, None]             # [w, 2]
         wmask = do_write.reshape(-1)
         wkind = c2.ws_kind.reshape(-1)
@@ -468,7 +481,41 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
         newval = newval.reshape(-1, val_words)
         newval = jnp.where((wkind == 2)[:, None], U32(0),
                            newval)                      # delete zeroes
-        if use_hotset:
+        newver = (vv >> 1) + 1
+        flags_del = (wkind == 2).astype(I32)
+        log_tbl = c2.ws_tbl.reshape(-1)
+        log_key = c2.ws_key.reshape(-1).astype(U32)
+        zero_hi = jnp.zeros_like(log_key)
+        if use_fused:
+            # install_log megakernel: the val + meta installs, the
+            # replicated log append, and (hotset) the mirror write-through
+            # are N masked row-scatter streams of ONE dispatch. The log
+            # plan (lane/rank/slot + replica-packed rows) is the exact
+            # append_rep plan, so ring bytes match the unfused path
+            lflat, entry3, lane_counts = logring.plan_rep(
+                db.log, wmask, log_tbl, flags_del, zero_hi, log_key,
+                newver, newval)
+            wsr = c2.ws_rows.reshape(-1)
+            widx = jnp.where(wmask, wsr, -1)
+            tabs = [db.val, db.meta, db.log.entries.reshape(-1)]
+            idxs = [widx, widx, lflat]
+            vals = [newval.reshape(-1), meta_new, entry3.reshape(-1)]
+            vws = [val_words, 1, db.log.entries.shape[1]]
+            if use_hotset:
+                w_midx = jnp.where(wmask & (wsr < hn), wsr, -1)
+                tabs += [hot_val, hot_meta]
+                idxs += [w_midx, w_midx]
+                vals += [newval.reshape(-1), meta_new]
+                vws += [val_words, 1]
+            outs = pg.scatter_streams(tuple(tabs), tuple(idxs),
+                                      tuple(vals), tuple(vws))
+            val, meta = outs[0], outs[1]
+            logs = db.log.replace(
+                entries=outs[2].reshape(db.log.entries.shape),
+                head=db.log.head + lane_counts)
+            if use_hotset:
+                hot_val, hot_meta = outs[3], outs[4]
+        elif use_hotset:
             # partitioned write-through install: the row prefix is the hot
             # set, so mirror index == row for hot rows (fused kernel on the
             # pallas route, double 1-D unique-index scatters on XLA)
@@ -492,14 +539,10 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
             val = db.val.at[wflat].set(newval.reshape(-1), mode="drop",
                                        unique_indices=True)
 
-    with waves.scope("tatp_dense", "log_append"):
-        newver = (vv >> 1) + 1
-        flags_del = (wkind == 2).astype(I32)
-        log_tbl = c2.ws_tbl.reshape(-1)
-        log_key = c2.ws_key.reshape(-1).astype(U32)
-        zero_hi = jnp.zeros_like(log_key)
-        logs = logring.append_rep(db.log, wmask, log_tbl, flags_del,
-                                  zero_hi, log_key, newver, newval)
+    if not use_fused:
+        with waves.scope("tatp_dense", "log_append"):
+            logs = logring.append_rep(db.log, wmask, log_tbl, flags_del,
+                                      zero_hi, log_key, newver, newval)
 
     # ---- wave 1: new cohort read + lock -----------------------------------
     if gen_new:
@@ -521,25 +564,54 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
     rows = jnp.where(used, base[tbl] + kk, sent)                # [w, K]
     is_read = ops == Op.OCC_READ
 
-    # ONE fused meta gather serves wave 2 (c1's validate re-read) AND
-    # wave 1 (the new cohort's reads). Both gathers depend on the same
-    # install scatter and on nothing else of each other, so XLA could
-    # overlap their DMAs (PERF.md round-3 finding 3) — the fusion still
-    # halves per-op launch/descriptor overhead on ops measured at
-    # 0.6-0.9 ms per 16-32k random indices
-    with waves.scope("tatp_dense", "meta_gather"):
-        gidx = jnp.concatenate([c1.rows.reshape(-1), rows.reshape(-1)])
-        if use_hotset:
-            g_midx = jnp.where(gidx < hn, gidx, -1)
-            g = pg.hot_gather(meta, hot_meta, gidx, g_midx, 1,
-                              use_pallas=use_pallas)
-        else:
-            g = pg.gather_rows(meta, gidx, 1) if use_pallas else meta[gidx]
-        vvB = g[: w * K].reshape(w, K)                          # [w, K]
-        rmeta = g[w * K:].reshape(w, K)                         # [w, K]
+    if use_fused:
+        # lock_validate megakernel: c1's validate re-read + verdict, the
+        # new cohort's fresh meta read, and the whole lock-arbitration RMW
+        # (hot_n arb-prefix residency included) in ONE dispatch. The meta
+        # reads ride the same kernel as the arb write-back; outputs are
+        # bit-identical to the unfused pair (tests/test_fused_ops.py).
+        # The lock chain runs on the arb array, independent of meta, so
+        # hoisting it into this wave cannot change any output.
+        with waves.scope("tatp_dense", "lock_validate"):
+            ws_rows = jnp.where(ws_active, base[ws_tbl] + ws_key,
+                                sent)                           # [w, 2]
+            flat_ws = ws_rows.reshape(-1)
+            active = ws_active.reshape(-1)
+            if counters is not None:
+                # won-vs-lost split needs the pre-arbitration stamps, read
+                # BEFORE the kernel aliases arb in place (read-before-
+                # donate, same as the unfused pallas route)
+                held = (db.arb[flat_ws] >> K_ARB) == (t - 1)
+            arb, grant_u, vbad, rmeta_f = pg.lock_validate(
+                db.arb, meta, c1.rows.reshape(-1), c1.vv1.reshape(-1),
+                rows.reshape(-1), flat_ws, active, t, K_ARB,
+                hot_n=hn if use_hotset else 0)
+            grant = (grant_u != 0).reshape(w, 2)
+            rmeta = rmeta_f.reshape(w, K)                       # [w, K]
+        # in-kernel verdict == (meta[vidx] != vv1); the is_read mask is
+        # applied here exactly as the unfused compare applied it
+        bad = c1.is_read & (vbad.reshape(w, K) != 0)
+    else:
+        # ONE fused meta gather serves wave 2 (c1's validate re-read) AND
+        # wave 1 (the new cohort's reads). Both gathers depend on the same
+        # install scatter and on nothing else of each other, so XLA could
+        # overlap their DMAs (PERF.md round-3 finding 3) — the fusion still
+        # halves per-op launch/descriptor overhead on ops measured at
+        # 0.6-0.9 ms per 16-32k random indices
+        with waves.scope("tatp_dense", "meta_gather"):
+            gidx = jnp.concatenate([c1.rows.reshape(-1), rows.reshape(-1)])
+            if use_hotset:
+                g_midx = jnp.where(gidx < hn, gidx, -1)
+                g = pg.hot_gather(meta, hot_meta, gidx, g_midx, 1,
+                                  use_pallas=use_pallas)
+            else:
+                g = (pg.gather_rows(meta, gidx, 1) if use_pallas
+                     else meta[gidx])
+            vvB = g[: w * K].reshape(w, K)                      # [w, K]
+            rmeta = g[w * K:].reshape(w, K)                     # [w, K]
+        bad = c1.is_read & (vvB != c1.vv1)
 
     # ---- wave 2 of c1: validate read-set version compare ------------------
-    bad = c1.is_read & (vvB != c1.vv1)
     changed = bad.any(axis=1)
     if counters is not None:
         # lanes of surviving RW txns checked / failed — the same lane set
@@ -581,41 +653,44 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
     # install chain. held = stamped by the previous step; c2's stamps
     # (t-2) expired this step, matching the wave-3 release timing above.
     # Candidates for held rows are masked OUT of the scatter so rejected
-    # attempts cannot keep a hot row stamped (no livelock).
-    with waves.scope("tatp_dense", "lock"):
-        ws_rows = jnp.where(ws_active, base[ws_tbl] + ws_key,
-                            sent)                               # [w, 2]
-        ws_vv = jnp.take_along_axis(rmeta, ws_lane, axis=1)
-        flat_ws = ws_rows.reshape(-1)
-        active = ws_active.reshape(-1)
-        if use_pallas:
-            if counters is not None:
-                # the fused kernel only exposes winners; the won-vs-lost
-                # split needs the pre-arbitration stamps, read BEFORE the
-                # kernel aliases arb in place (a read-before-donate, which
-                # the dintlint aliasing pass permits; bit-identical to the
-                # XLA path's arb_old gather)
-                held = ((pg.gather_rows(db.arb, flat_ws, 1) >> K_ARB)
-                        == (t - 1))
-            # fused kernel pass: gather + stamp compare + first-lane-wins
-            # scatter-max + winner read-back in ONE launch, arb updated in
-            # place (bit-identical to the XLA chain below — pinned in
-            # tests/test_pallas_ops.py)
-            # hot_n > 0 caches the arb prefix in VMEM for the pass
-            # (dintcache); outputs bit-identical either way
-            arb, grant_u = pg.lock_arbitrate(db.arb, flat_ws, active, t,
-                                             K_ARB,
-                                             hot_n=hn if use_hotset else 0)
-            grant = (grant_u != 0).reshape(w, 2)
-        else:
-            arb_old = db.arb[flat_ws]   # [2w]; sentinel never stamped
-            held = (arb_old >> K_ARB) == (t - 1)
-            inv_slot = U32(2 * w - 1) - jnp.arange(2 * w, dtype=U32)
-            packed = (t << K_ARB) | inv_slot
-            cand = active & ~held
-            arb = db.arb.at[jnp.where(cand, flat_ws, oob)].max(packed,
-                                                               mode="drop")
-            grant = (cand & (arb[flat_ws] == packed)).reshape(w, 2)
+    # attempts cannot keep a hot row stamped (no livelock). On the fused
+    # route the whole chain already ran inside lock_validate above.
+    ws_vv = jnp.take_along_axis(rmeta, ws_lane, axis=1)
+    if not use_fused:
+        with waves.scope("tatp_dense", "lock"):
+            ws_rows = jnp.where(ws_active, base[ws_tbl] + ws_key,
+                                sent)                           # [w, 2]
+            flat_ws = ws_rows.reshape(-1)
+            active = ws_active.reshape(-1)
+            if use_pallas:
+                if counters is not None:
+                    # the fused kernel only exposes winners; the
+                    # won-vs-lost split needs the pre-arbitration stamps,
+                    # read BEFORE the kernel aliases arb in place (a
+                    # read-before-donate, which the dintlint aliasing pass
+                    # permits; bit-identical to the XLA path's arb_old
+                    # gather)
+                    held = ((pg.gather_rows(db.arb, flat_ws, 1) >> K_ARB)
+                            == (t - 1))
+                # fused kernel pass: gather + stamp compare + first-lane-
+                # wins scatter-max + winner read-back in ONE launch, arb
+                # updated in place (bit-identical to the XLA chain below —
+                # pinned in tests/test_pallas_ops.py)
+                # hot_n > 0 caches the arb prefix in VMEM for the pass
+                # (dintcache); outputs bit-identical either way
+                arb, grant_u = pg.lock_arbitrate(
+                    db.arb, flat_ws, active, t, K_ARB,
+                    hot_n=hn if use_hotset else 0)
+                grant = (grant_u != 0).reshape(w, 2)
+            else:
+                arb_old = db.arb[flat_ws]   # [2w]; sentinel never stamped
+                held = (arb_old >> K_ARB) == (t - 1)
+                inv_slot = U32(2 * w - 1) - jnp.arange(2 * w, dtype=U32)
+                packed = (t << K_ARB) | inv_slot
+                cand = active & ~held
+                arb = db.arb.at[jnp.where(cand, flat_ws, oob)].max(
+                    packed, mode="drop")
+                grant = (cand & (arb[flat_ws] == packed)).reshape(w, 2)
 
     # reply types: reads from the gather; write-slot GRANT/REJECT direct
     rt = jnp.where(is_read & used,
@@ -648,10 +723,18 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
         hot_ctrs = {}
         if use_hotset:
             # partition accounting over the meta + magic gathers (the arb
-            # prefix residency has no per-lane split to count)
-            hits = (g_midx >= 0).sum(dtype=I32)
-            lanes = 2 * w * K
-            refresh = hn * 4
+            # prefix residency has no per-lane split to count). The fused
+            # lock_validate reads the main meta table directly (bit-
+            # identical by the mirror invariant), so its lanes are not
+            # partitioned and only the magic gather counts there
+            if use_fused:
+                hits = jnp.asarray(0, I32)
+                lanes = 0
+                refresh = 0
+            else:
+                hits = (g_midx >= 0).sum(dtype=I32)
+                lanes = 2 * w * K
+                refresh = hn * 4
             if check_magic:
                 hits = hits + (mg_midx >= 0).sum(dtype=I32)
                 lanes += w * K
@@ -682,6 +765,7 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
             mon.CTR_LOG_APPENDS: wmask.sum(dtype=I32),
             (mon.CTR_DISPATCH_PALLAS if use_pallas
              else mon.CTR_DISPATCH_XLA): 1,
+            **({mon.CTR_FUSED_DISPATCH: 1} if use_fused else {}),
         })
         counters = mon.gauge_max(
             counters, {mon.CTR_RING_HWM: logs.head.max()})
@@ -720,6 +804,7 @@ def build_pipelined_runner(n_sub: int, w: int = 8192, val_words: int = 10,
                            cohorts_per_block: int = 8, mix=None,
                            check_magic: bool = True, use_pallas=None,
                            use_hotset: bool = False, hot_frac=None,
+                           use_fused=None, log_replicas: int = N_SHARDS,
                            monitor: bool = False):
     """jit(scan(pipe_step)) over carry (db, c1, c2); same contract as
     tatp_pipeline.build_pipelined_runner: returns (run, init, drain).
@@ -735,6 +820,13 @@ def build_pipelined_runner(n_sub: int, w: int = 8192, val_words: int = 10,
     unless the experiment skews it; pass use_hotset=True (hot_frac = the
     mirrored fraction of the subscriber prefix, default 4%) for
     skewed-TATP experiments. init() attaches the mirror.
+
+    ``use_fused``: None = honor DINT_USE_FUSED env; True/False forces.
+    Routes the step through the round-12 megakernels (lock_validate +
+    install_log) after probing them at this runner's geometry —
+    ``log_replicas`` must match the DenseDB's log (it sizes the log
+    stream's row width for the probe). Probe failure degrades to the
+    unfused path with a logged warning (pg.resolve_use_fused).
 
     ``monitor``: thread the dintmon counter plane through the carry. The
     carry grows a trailing monitor.Counters leaf (init creates it; read
@@ -752,9 +844,18 @@ def build_pipelined_runner(n_sub: int, w: int = 8192, val_words: int = 10,
         if use_pallas and not pg.hot_kernels_available(
                 n_idx=2 * w * K, m_lock=2 * w, k_arb=K_ARB):
             use_pallas = False      # partition stays; XLA serves it
+    ew3 = int(log_replicas) * (logring.HDR_WORDS + val_words)
+    scat_geoms = ((2 * w, val_words), (2 * w, 1), (2 * w, ew3))
+    if use_hotset:
+        scat_geoms = scat_geoms + ((2 * w, val_words), (2 * w, 1))
+    use_fused = pg.resolve_use_fused(
+        use_fused,
+        lockv=(w * K, w * K, 2 * w, K_ARB,
+               hot_rows if use_hotset else 0),
+        scatters=scat_geoms)
     kw = dict(w=w, n_sub=n_sub, val_words=val_words,
               check_magic=check_magic, use_pallas=use_pallas,
-              use_hotset=use_hotset)
+              use_hotset=use_hotset, use_fused=use_fused)
 
     def step_mon(db, c1, c2, key, cnt, **skw):
         """pipe_step + (counters or None), normalized to a fixed arity."""

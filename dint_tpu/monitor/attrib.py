@@ -52,6 +52,39 @@ DEFAULT_STEP_PCT = 10.0
 DEFAULT_RATE_PCT = 10.0
 DEFAULT_MIN_MS = 0.05
 
+# Round-12 fused megakernels: each swallows a PAIR of unfused waves, so a
+# fused-vs-unfused A/B sees the constituents vanish on one side. Without
+# folding, the diff reports them under "missing" and the fused successor
+# as an infinite regression — both meaningless. This map sends each
+# swallowed constituent to its fused successor; diff_breakdowns folds the
+# constituents' time into the successor on BOTH sides whenever either
+# side observed the fused wave, so the gate compares like against like
+# (the unfused side's lock + meta_gather total vs the fused side's one
+# lock_validate dispatch). `tools/dintscope.py diff --no-alias` disables
+# the fold for debugging raw per-scope time. Waves that only SHRINK under
+# fusion (smallbank's lock scope keeps its XLA scatter-mins; the sharded
+# install_route keeps its all_to_all) still alias: their remaining time
+# plus the megakernel is exactly what the unfused scope used to cover.
+WAVE_ALIASES: dict[str, str] = {
+    waves.full_name(e, src): waves.full_name(e, dst)
+    for e, src, dst in (
+        ("tatp_dense", "lock", "lock_validate"),
+        ("tatp_dense", "meta_gather", "lock_validate"),
+        ("tatp_dense", "install", "install_log"),
+        ("tatp_dense", "log_append", "install_log"),
+        ("smallbank_dense", "lock", "lock_validate"),
+        ("smallbank_dense", "read", "lock_validate"),
+        ("smallbank_dense", "install", "install_log"),
+        ("smallbank_dense", "log_append", "install_log"),
+        ("dense_sharded_sb", "arbitrate", "lock_validate"),
+        ("dense_sharded_sb", "install_route", "install_log"),
+    )
+}
+for _src, _dst in WAVE_ALIASES.items():
+    assert _src in waves.WAVE_DOCS and _dst in waves.WAVE_DOCS, (
+        f"WAVE_ALIASES references unregistered wave: {_src} -> {_dst}")
+del _src, _dst
+
 
 # ---------------------------------------------------------------- loading
 
@@ -240,24 +273,88 @@ def load_breakdown(path: str) -> dict:
 # ------------------------------------------------------------------- diff
 
 
+def _wave_observed(w: dict, name: str) -> bool:
+    r = w.get(name) or {}
+    return (r.get("slices") or 0) > 0 or (r.get("ms") or 0) > 0
+
+
+def _fold_aliases(wa: dict, wb: dict) -> tuple[dict, dict, dict]:
+    """Fold WAVE_ALIASES constituents into their fused successor on both
+    sides of a diff — but ONLY for successors whose observation pattern
+    is asymmetric between the sides (one side dispatched the megakernel,
+    the other ran the unfused pair). A symmetric diff (unfused vs
+    unfused, fused vs fused, or the all-waves synthetic fixture) never
+    folds: its per-wave rows are already like-for-like and folding would
+    only blur which wave moved. Returns (wa', wb', folded) where folded
+    maps each triggered fused wave to the sorted constituents merged
+    into it."""
+    targets: dict[str, list[str]] = {}
+    for src, dst in WAVE_ALIASES.items():
+        oa, ob = _wave_observed(wa, dst), _wave_observed(wb, dst)
+        asym = oa != ob or (_wave_observed(wa, src)
+                            != _wave_observed(wb, src))
+        if (oa or ob) and asym:
+            targets.setdefault(dst, []).append(src)
+    if not targets:
+        return wa, wb, {}
+    for dst in targets:
+        targets[dst].sort()
+
+    def fold(w: dict) -> dict:
+        out = {k: dict(v) for k, v in w.items() if isinstance(v, dict)}
+        for dst, srcs in targets.items():
+            d = out.setdefault(dst, {"ms": 0.0, "slices": 0,
+                                     "ms_per_step": None, "pct": 0.0,
+                                     "bytes_per_step": None, "gbps": None})
+            for src in srcs:
+                r = out.pop(src, None)
+                if not r:
+                    continue
+                d["ms"] = round((d.get("ms") or 0.0)
+                                + (r.get("ms") or 0.0), 6)
+                d["slices"] = (d.get("slices") or 0) + (r.get("slices")
+                                                        or 0)
+                d["pct"] = round((d.get("pct") or 0.0)
+                                 + (r.get("pct") or 0.0), 3)
+                ms, mr = d.get("ms_per_step"), r.get("ms_per_step")
+                if mr is not None:
+                    d["ms_per_step"] = round((ms or 0.0) + mr, 6)
+        return out
+
+    return fold(wa), fold(wb), targets
+
+
 def diff_breakdowns(a: dict, b: dict, *, wave_pct: float = DEFAULT_WAVE_PCT,
                     step_pct: float = DEFAULT_STEP_PCT,
                     rate_pct: float = DEFAULT_RATE_PCT,
-                    min_ms: float = DEFAULT_MIN_MS) -> dict:
+                    min_ms: float = DEFAULT_MIN_MS,
+                    alias: bool = True) -> dict:
     """Compare breakdown B (candidate) against A (baseline). A regression
     is: a wave's ms_per_step growing past ``wave_pct`` % (ignoring waves
     under ``min_ms`` on both sides — dispatch noise), the attributed step
     time growing past ``step_pct`` %, committed throughput falling past
-    ``rate_pct`` % (when both artifacts carry rates). Returns a dict with
+    ``rate_pct`` % (when both artifacts carry rates). With ``alias``
+    (default), WAVE_ALIASES folds the round-12 megakernels' swallowed
+    constituents into the fused wave on both sides before comparing, so a
+    fused-vs-unfused A/B attributes removed waves to their fused
+    successor instead of reporting them missing. Returns a dict with
     ``regressions`` (list of {kind, wave?, a, b, pct} — empty = gate
     passes); `tools/dintscope.py diff` exits 1 when it is non-empty."""
     regressions = []
     rows = []
     wa, wb = a.get("waves", {}), b.get("waves", {})
+    folded: dict[str, list[str]] = {}
+    if alias:
+        wa, wb, folded = _fold_aliases(wa, wb)
+    merged_away = {s for srcs in folded.values() for s in srcs}
     for name in waves.ALL_WAVES:
+        if name in merged_away:
+            continue
         ra, rb = wa.get(name) or {}, wb.get(name) or {}
         ma, mb = ra.get("ms_per_step"), rb.get("ms_per_step")
         row = {"wave": name, "a_ms_per_step": ma, "b_ms_per_step": mb}
+        if name in folded:
+            row["includes"] = folded[name]
         if ma is not None and mb is not None and max(ma, mb) >= min_ms:
             pct = 100.0 * (mb - ma) / ma if ma > 0 else float("inf")
             row["pct"] = round(pct, 2) if ma > 0 else None
@@ -287,6 +384,7 @@ def diff_breakdowns(a: dict, b: dict, *, wave_pct: float = DEFAULT_WAVE_PCT,
         "a": a.get("trace"), "b": b.get("trace"),
         "thresholds": {"wave_pct": wave_pct, "step_pct": step_pct,
                        "rate_pct": rate_pct, "min_ms": min_ms},
+        "aliased": folded,
         "rows": rows,
         "regressions": regressions,
         "ok": not regressions,

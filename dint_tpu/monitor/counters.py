@@ -104,6 +104,11 @@ _REGISTRY: tuple[tuple[str, str, str], ...] = (
      "bytes of hot-mirror bulk refresh DMA'd to VMEM by the pallas hot "
      "kernels (one mirror copy per partitioned gather; 0 on the XLA "
      "partition route, which has no residency to refresh)"),
+    ("fused_dispatch", FLOW,
+     "steps whose paired waves ran the round-12 megakernels "
+     "(lock_validate + install_log); counted ALONGSIDE dispatch_xla/"
+     "dispatch_pallas — the magic gather still dispatches by use_pallas, "
+     "so fused_dispatch <= steps and the xla/pallas split stays total"),
 )
 
 ALL_NAMES: tuple[str, ...] = tuple(n for n, _, _ in _REGISTRY)
@@ -140,6 +145,7 @@ CTR_DISPATCH_PALLAS = COUNTER_INDEX["dispatch_pallas"]
 CTR_HOT_HITS = COUNTER_INDEX["hot_hits"]
 CTR_HOT_COLD_ROWS = COUNTER_INDEX["hot_cold_rows"]
 CTR_HOT_REFRESH_BYTES = COUNTER_INDEX["hot_refresh_bytes"]
+CTR_FUSED_DISPATCH = COUNTER_INDEX["fused_dispatch"]
 
 # the subset defined with IDENTICAL semantics by the dense engines and
 # the generic sort-based pipelines: on the parity workloads

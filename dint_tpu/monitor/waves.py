@@ -134,6 +134,36 @@ _REGISTRY: tuple[tuple[str, str, str, str | None], ...] = (
     ("dense_sharded_sb", "replicate",
      "backup fan-out: ppermute applied installs to owner+1/+2, apply to "
      "backup copies + append local logs", None),
+    # --- round-12 fused megakernels (ops/pallas_gather.lock_validate +
+    # --- scatter_streams); each swallows a PAIR of the waves above.
+    # --- tools/dintscope.py maps the swallowed constituents onto these
+    # --- successors in fused-vs-unfused A/Bs (WAVE_ALIASES, attrib.py) --
+    ("tatp_dense", "lock_validate",
+     "megakernel: c1's validate ring-read + verdict, the new cohort's "
+     "fresh meta gather, and the whole lock-arbitration RMW in ONE "
+     "dispatch (swallows meta_gather + lock)", "3*2*w*4 + 2*w*k*4"),
+    ("tatp_dense", "install_log",
+     "megakernel: meta + val installs, the replicated log append, and "
+     "the hot-mirror write-through as N masked row-scatter streams of "
+     "ONE dispatch (swallows install + log_append)",
+     "2*w*(4 + 4*vw) + 2*w*3*(20 + 4*vw)"),
+    ("smallbank_dense", "lock_validate",
+     "megakernel: the lock wave's held-stamp gathers + the balance read "
+     "as gather streams of ONE dispatch (swallows lock's gathers + "
+     "read; the scatter-mins and grant compare stay XLA)", "6*w*l*4"),
+    ("smallbank_dense", "install_log",
+     "megakernel: balance install + log x3 append (+ hot-mirror "
+     "write-through) as scatter streams of ONE dispatch (swallows "
+     "install + log_append)", "w*l*4 + w*l*3*(20 + 4*vw)"),
+    ("dense_sharded_sb", "lock_validate",
+     "owner-side megakernel: arbitration stamp/balance gathers as "
+     "gather streams of ONE dispatch (swallows arbitrate's gathers)",
+     "5*w*l*4"),
+    ("dense_sharded_sb", "install_log",
+     "owner-side megakernel: primary balance install + owner CommitLog "
+     "append as scatter streams of ONE dispatch (swallows "
+     "install_route's writes; routing stays all_to_all)",
+     "w*l*8 + w*l*3*(20 + 4*vw)"),
 )
 
 

@@ -73,6 +73,19 @@ write through to, so there is no coherence protocol, just a partition:
   first-lane-wins equivalence proof carries over verbatim. hot_n=0 (the
   default) is the round-6 kernel.
 
+Round 12 adds the MEGAKERNEL family (`DINT_USE_FUSED` env / `use_fused=`
+kwarg, default off): each fuses a PAIR of adjacent engine waves into one
+dispatch, shortening the step's dependent-dispatch chain from ~6 to ~4.
+`lock_validate` composes the arb RMW (`_arb_rmw`, hot_n prefix included)
+with the OCC validate read and the next cohort's fresh meta read;
+`gather_streams`/`scatter_streams` run N independent gather/masked-
+scatter rings back-to-back in one launch (the install table write, its
+mirror write-through, and the replication-log append = `install_log`).
+Every stream is the round-6/10 ring verbatim — only dispatch boundaries
+are removed — so outputs stay bit-identical to the unfused path
+(tests/test_fused_ops.py) and `resolve_use_fused()` carries the same
+probe-and-degrade contract below.
+
 Fallback contract (ISSUE 1): Mosaic rejection must DEGRADE, not crash —
 round 3 already hit one such rejection class (scalar VMEM stores,
 tools/profile_pallas.py). `resolve_use_pallas()` therefore compiles + runs
@@ -128,6 +141,10 @@ def env_use_pallas() -> bool:
 
 def env_use_hotset() -> bool:
     return os.environ.get("DINT_USE_HOTSET", "0") not in ("", "0")
+
+
+def env_use_fused() -> bool:
+    return os.environ.get("DINT_USE_FUSED", "0") not in ("", "0")
 
 
 def resolve_use_hotset(explicit: bool | None = None) -> bool:
@@ -457,13 +474,16 @@ def hot_scatter(tab, mirror, idx, midx, mask, vals, vw: int = 1,
 # ------------------------------------------------------- fused lock pass
 
 
-def _arbitrate_kernel(k_arb: int, hot_n: int, rows_ref, act_ref, t_ref,
-                      arb_in, arb_out, grant_out, rbuf, wbuf, gbuf,
-                      win_row, hot_vmem, rsem, wsem, gsem, hsem):
+def _arb_rmw(k_arb: int, hot_n: int, rows_ref, act_ref, t, arb_out,
+             rbuf, wbuf, gbuf, win_row, hot_vmem, rsem, wsem, hsem):
     """Sequential first-lane-wins RMW over M lock lanes — the fused form of
-    gather -> scatter-max -> gather-back (bit-equivalence argument in the
-    module docstring). arb_in/arb_out alias (in-place update of the HBM
-    array); grants accumulate in SMEM and leave in one trailing DMA.
+    gather -> stamp-compare -> scatter-max (bit-equivalence argument in the
+    module docstring). Grants accumulate in the SMEM ``gbuf``; the caller
+    DMAs them out (lock_arbitrate's trailing copy) or keeps composing
+    (lock_validate). This is the WHOLE arbitration pass — hot-prefix
+    load/store, ring init, prime, body, drain — factored so the megakernel
+    reuses it verbatim and the round-6 equivalence proof carries over
+    unchanged.
 
     ``hot_n`` > 0 additionally caches the arb prefix [0, hot_n) in VMEM
     for the whole pass: lanes on hot rows RMW against the VMEM copy
@@ -475,7 +495,6 @@ def _arbitrate_kernel(k_arb: int, hot_n: int, rows_ref, act_ref, t_ref,
     than the ring depth has landed, the SMEM window catches the rest —
     holds verbatim."""
     m = rows_ref.shape[0]
-    t = t_ref[0]
 
     if hot_n > 0:
         load = pltpu.make_async_copy(arb_out.at[pl.ds(0, hot_n)],
@@ -610,6 +629,15 @@ def _arbitrate_kernel(k_arb: int, hot_n: int, rows_ref, act_ref, t_ref,
         store.start()
         store.wait()
 
+
+def _arbitrate_kernel(k_arb: int, hot_n: int, rows_ref, act_ref, t_ref,
+                      arb_in, arb_out, grant_out, rbuf, wbuf, gbuf,
+                      win_row, hot_vmem, rsem, wsem, gsem, hsem):
+    """The standalone lock pass: the RMW core plus one trailing DMA that
+    carries the SMEM grant bits out. arb_in/arb_out alias (in-place update
+    of the HBM array)."""
+    _arb_rmw(k_arb, hot_n, rows_ref, act_ref, t_ref[0], arb_out, rbuf,
+             wbuf, gbuf, win_row, hot_vmem, rsem, wsem, hsem)
     out = pltpu.make_async_copy(gbuf, grant_out, gsem)
     out.start()
     out.wait()
@@ -668,6 +696,314 @@ def lock_arbitrate(arb, rows, active, step, k_arb: int,
     )(rows.astype(I32), active.astype(I32),
       step.reshape(1).astype(U32), arb)
     return arb2, grant
+
+
+# ------------------------------------------------- round-12 megakernels
+#
+# Two fusions that each swallow a PAIR of adjacent waves of the engine
+# step (PERF.md round 12), shortening the dependency chain from ~6
+# dispatches to ~4:
+#
+# * lock_validate — the lock-arbitration RMW (_arb_rmw, including its
+#   hot_n VMEM prefix residency) composed with the OCC validate read and
+#   the next cohort's fresh meta read in ONE dispatch. meta and arb are
+#   disjoint arrays, so phase order inside the kernel cannot change any
+#   output and the round-6 first-lane-wins proof carries verbatim.
+#
+# * gather_streams / scatter_streams — N independent row-gather /
+#   masked-row-scatter rings run back-to-back inside one dispatch (the
+#   install table write, its mirror write-through, and the replication-
+#   log append become one kernel: install_log). Each stream is the
+#   round-6/round-10 single-target ring verbatim; only the dispatch
+#   boundary between them is removed. Streams must target DISJOINT
+#   arrays; indices < 0 are masked lanes (no traffic); masked-in indices
+#   per stream must be unique — the engines' one-writer-per-row
+#   certification, identical to their unique_indices=True XLA scatters.
+
+
+def _lock_validate_kernel(k_arb: int, hot_n: int, vidx_ref, vv1_ref,
+                          ridx_ref, rows_ref, act_ref, t_ref, meta_in,
+                          arb_in, arb_out, grant_out, vbad_out, rmeta_out,
+                          rbuf, wbuf, gbuf, win_row, hot_vmem, vrbuf, vb,
+                          rsem, wsem, gsem, hsem, vsem, vbsem, msem):
+    """The lock+validate megakernel: (1) ring-gather each validate lane's
+    packed meta word into SMEM and compare against the expected version
+    (vb[i] = word != vv1[i]); (2) ring-gather the next cohort's fresh
+    meta words straight to HBM (_gather_kernel verbatim); (3) run the
+    arbitration RMW (_arb_rmw verbatim); (4) DMA the grant bits and
+    validate verdicts out. meta_in and arb_out are disjoint arrays, so
+    the phases commute with the unfused two-dispatch schedule bit for
+    bit."""
+    v = vidx_ref.shape[0]
+    t = t_ref[0]
+
+    def vcopy(i):
+        return pltpu.make_async_copy(
+            meta_in.at[pl.ds(vidx_ref[i], 1)],
+            vrbuf.at[pl.ds(jax.lax.rem(i, RMW_SLOTS), 1)],
+            vsem.at[jax.lax.rem(i, RMW_SLOTS)])
+
+    def vprime(i, _):
+        vcopy(i).start()
+        return 0
+
+    jax.lax.fori_loop(0, min(RMW_SLOTS, v), vprime, 0)
+
+    def vbody(i, _):
+        vcopy(i).wait()
+        word = vrbuf[jax.lax.rem(i, RMW_SLOTS)]
+        vb[i] = jax.lax.select(word != vv1_ref[i], U32(1), U32(0))
+
+        # the slot's word was consumed above, so reuse is hazard-free
+        @pl.when(i + RMW_SLOTS < v)
+        def _():
+            vcopy(i + RMW_SLOTS).start()
+
+        return 0
+
+    jax.lax.fori_loop(0, v, vbody, 0)
+
+    _gather_kernel(1, NSLOTS, ridx_ref, meta_in, rmeta_out, msem)
+
+    _arb_rmw(k_arb, hot_n, rows_ref, act_ref, t, arb_out, rbuf, wbuf,
+             gbuf, win_row, hot_vmem, rsem, wsem, hsem)
+
+    gout = pltpu.make_async_copy(gbuf, grant_out, gsem)
+    gout.start()
+    gout.wait()
+    vout = pltpu.make_async_copy(vb, vbad_out, vbsem)
+    vout.start()
+    vout.wait()
+
+
+@functools.partial(jax.jit, static_argnums=(8, 9, 10))
+def lock_validate(arb, meta, vidx, vv1, ridx, rows, active, step,
+                  k_arb: int, interpret: bool | None = None,
+                  hot_n: int = 0):
+    """Fused lock+validate pass. Returns (arb', grant u32[M], vbad u32[V],
+    rmeta u32[R]) where (arb', grant) are bit-identical to
+    `lock_arbitrate(arb, rows, active, step, k_arb, hot_n=hot_n)`,
+    `vbad[i] = (meta[vidx[i]] != vv1[i])` (the OCC validate verdict; the
+    engine masks it with is_read afterwards exactly as it masked the
+    unfused compare), and `rmeta = meta[ridx]` (the next cohort's version
+    seeds, == gather_rows(meta, ridx, 1)). All indices must be in-bounds
+    (sentinel-clamped by the engines, same contract as gather_rows). The
+    arb buffer is donated and updated in place."""
+    if interpret is None:
+        interpret = use_interpret()
+    m = rows.shape[0]
+    v = vidx.shape[0]
+    r = ridx.shape[0]
+    assert 0 <= hot_n <= arb.shape[0]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=6,
+        grid=(1,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY),
+                  pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=(pl.BlockSpec(memory_space=pltpu.ANY),
+                   pl.BlockSpec(memory_space=pltpu.ANY),
+                   pl.BlockSpec(memory_space=pltpu.ANY),
+                   pl.BlockSpec(memory_space=pltpu.ANY)),
+        scratch_shapes=[
+            pltpu.SMEM((RMW_SLOTS,), U32),    # rbuf: in-flight read words
+            pltpu.SMEM((RMW_SLOTS,), U32),    # wbuf: in-flight write words
+            pltpu.SMEM((m,), U32),            # gbuf: per-lane grant bits
+            pltpu.SMEM((WIN,), I32),          # win_row: recent granted rows
+            pltpu.VMEM((max(hot_n, 1),), U32),  # hot arb prefix residency
+            pltpu.SMEM((RMW_SLOTS,), U32),    # vrbuf: in-flight meta words
+            pltpu.SMEM((v,), U32),            # vb: per-lane validate bits
+            pltpu.SemaphoreType.DMA((RMW_SLOTS,)),   # rsem
+            pltpu.SemaphoreType.DMA((RMW_SLOTS,)),   # wsem
+            pltpu.SemaphoreType.DMA(()),             # gsem
+            pltpu.SemaphoreType.DMA(()),             # hsem
+            pltpu.SemaphoreType.DMA((RMW_SLOTS,)),   # vsem
+            pltpu.SemaphoreType.DMA(()),             # vbsem
+            pltpu.SemaphoreType.DMA((NSLOTS,)),      # msem (rmeta ring)
+        ],
+    )
+    arb2, grant, vbad, rmeta = pl.pallas_call(
+        functools.partial(_lock_validate_kernel, k_arb, hot_n),
+        grid_spec=grid_spec,
+        out_shape=(jax.ShapeDtypeStruct(arb.shape, U32),
+                   jax.ShapeDtypeStruct((m,), U32),
+                   jax.ShapeDtypeStruct((v,), U32),
+                   jax.ShapeDtypeStruct((r,), U32)),
+        # operand 7 (post scalar-prefetch: meta, arb) -> output 0
+        input_output_aliases={7: 0},
+        interpret=bool(interpret),
+    )(vidx.astype(I32), vv1.astype(U32), ridx.astype(I32),
+      rows.astype(I32), active.astype(I32), step.reshape(1).astype(U32),
+      meta, arb)
+    return arb2, grant, vbad, rmeta
+
+
+def _gather_streams_kernel(vws: tuple, nslots: int, *refs):
+    s_n = len(vws)
+    idxs = refs[:s_n]
+    tabs = refs[s_n:2 * s_n]
+    outs = refs[2 * s_n:3 * s_n]
+    sems = refs[3 * s_n:]
+    for s in range(s_n):
+        _gather_kernel(vws[s], nslots, idxs[s], tabs[s], outs[s], sems[s])
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def gather_streams(tabs, idxs, vws: tuple, interpret: bool | None = None):
+    """N independent row gathers in ONE dispatch: stream s gathers
+    `idxs[s]` rows of `vws[s]` u32 words from `tabs[s]` — each stream is
+    _gather_kernel verbatim, so per-stream semantics equal
+    `gather_rows(tabs[s], idxs[s], vws[s])` bit for bit. Returns a tuple
+    of u32 [K_s * vws[s]] arrays."""
+    if interpret is None:
+        interpret = use_interpret()
+    tabs = tuple(tabs)
+    idxs = tuple(i.astype(I32) for i in idxs)
+    s_n = len(vws)
+    assert len(tabs) == len(idxs) == s_n
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=s_n,
+        grid=(1,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)] * s_n,
+        out_specs=tuple(pl.BlockSpec(memory_space=pltpu.ANY)
+                        for _ in range(s_n)),
+        scratch_shapes=[pltpu.SemaphoreType.DMA((NSLOTS,))
+                        for _ in range(s_n)],
+    )
+    return pl.pallas_call(
+        functools.partial(_gather_streams_kernel, tuple(vws), NSLOTS),
+        grid_spec=grid_spec,
+        out_shape=tuple(
+            jax.ShapeDtypeStruct((idxs[s].shape[0] * vws[s],), U32)
+            for s in range(s_n)),
+        interpret=bool(interpret),
+    )(*idxs, *tabs)
+
+
+def _xla_gather_streams(tabs, idxs, vws):
+    """XLA form of gather_streams (per-stream flat gathers) — the probe
+    ground truth and the shape the unfused engine paths already emit."""
+    outs = []
+    for tab, idx, vw in zip(tabs, idxs, vws):
+        idx = idx.astype(I32)
+        flat = (idx[:, None] * vw + jnp.arange(vw, dtype=I32)).reshape(-1)
+        outs.append(tab[flat])
+    return tuple(outs)
+
+
+def _scatter_one_stream(vw: int, nslots: int, idx_ref, vals_ref, out_ref,
+                        trk, sem):
+    """One masked row-scatter ring (idx < 0 = masked lane, no traffic):
+    the scatter_rows_hot single-target discipline — a per-slot SMEM
+    tracker records WHICH lane's copy occupies a ring slot so reuse
+    force-waits exactly the copies that were started."""
+    k = idx_ref.shape[0]
+
+    def cp(i):
+        return pltpu.make_async_copy(
+            vals_ref.at[pl.ds(i * vw, vw)],
+            out_ref.at[pl.ds(idx_ref[i] * vw, vw)],
+            sem.at[jax.lax.rem(i, nslots)])
+
+    def init(s, _):
+        trk[s] = I32(-1)
+        return 0
+
+    jax.lax.fori_loop(0, nslots, init, 0)
+
+    def body(i, _):
+        s = jax.lax.rem(i, nslots)
+
+        @pl.when(trk[s] >= 0)
+        def _():
+            cp(trk[s]).wait()
+
+        trk[s] = I32(-1)
+
+        @pl.when(idx_ref[i] >= 0)
+        def _():
+            cp(i).start()
+            trk[s] = i
+
+        return 0
+
+    jax.lax.fori_loop(0, k, body, 0)
+
+    def drain(s, _):
+        @pl.when(trk[s] >= 0)
+        def _():
+            cp(trk[s]).wait()
+
+        return 0
+
+    jax.lax.fori_loop(0, nslots, drain, 0)
+
+
+def _scatter_streams_kernel(vws: tuple, nslots: int, *refs):
+    s_n = len(vws)
+    idxs = refs[:s_n]
+    vals = refs[s_n:2 * s_n]
+    # refs[2*s_n : 3*s_n] are the aliased table INPUTS — never read; the
+    # in-place targets are the aliased outputs
+    outs = refs[3 * s_n:4 * s_n]
+    trks = refs[4 * s_n:5 * s_n]
+    sems = refs[5 * s_n:]
+    for s in range(s_n):
+        _scatter_one_stream(vws[s], nslots, idxs[s], vals[s], outs[s],
+                            trks[s], sems[s])
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4), donate_argnums=(0,))
+def scatter_streams(tabs, idxs, vals, vws: tuple,
+                    interpret: bool | None = None):
+    """N independent masked row scatters in ONE dispatch (the install_log
+    megakernel): stream s writes `vals[s]` row i into
+    `tabs[s][idxs[s][i]*vw +: vw]` for every lane with `idxs[s][i] >= 0`;
+    lanes with idx < 0 write nothing. Streams must target DISJOINT
+    arrays; masked-in indices per stream must be unique (the engines'
+    one-writer-per-row certification). Every table is donated and updated
+    in place; returns the updated tuple, bit-identical per stream to the
+    engines' `tab.at[flat].set(vals, mode="drop", unique_indices=True)`
+    with the mask folded onto an OOB row."""
+    if interpret is None:
+        interpret = use_interpret()
+    tabs = tuple(tabs)
+    idxs = tuple(i.astype(I32) for i in idxs)
+    vals = tuple(vals)
+    s_n = len(vws)
+    assert len(tabs) == len(idxs) == len(vals) == s_n
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=s_n,
+        grid=(1,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)] * (2 * s_n),
+        out_specs=tuple(pl.BlockSpec(memory_space=pltpu.ANY)
+                        for _ in range(s_n)),
+        scratch_shapes=(
+            [pltpu.SMEM((NSLOTS,), I32) for _ in range(s_n)]
+            + [pltpu.SemaphoreType.DMA((NSLOTS,)) for _ in range(s_n)]),
+    )
+    return pl.pallas_call(
+        functools.partial(_scatter_streams_kernel, tuple(vws), NSLOTS),
+        grid_spec=grid_spec,
+        out_shape=tuple(jax.ShapeDtypeStruct(t.shape, U32) for t in tabs),
+        # operands 2S+s (post scalar-prefetch: vals x S, tabs x S) -> s
+        input_output_aliases={2 * s_n + s: s for s in range(s_n)},
+        interpret=bool(interpret),
+    )(*idxs, *vals, *tabs)
+
+
+def _xla_scatter_streams(tabs, idxs, vals, vws):
+    """XLA form of scatter_streams: per-stream 1-D unique-index drop
+    scatters with masked lanes folded onto the OOB row — exactly the
+    shape the unfused engine installs already emit."""
+    outs = []
+    for tab, idx, val, vw in zip(tabs, idxs, vals, vws):
+        idx = idx.astype(I32)
+        n = tab.shape[0] // vw
+        widx = jnp.where(idx >= 0, idx, n)
+        wflat = (widx[:, None] * vw + jnp.arange(vw, dtype=I32)).reshape(-1)
+        outs.append(tab.at[wflat].set(val.astype(U32), mode="drop",
+                                      unique_indices=True))
+    return tuple(outs)
 
 
 # ------------------------------------------------------ fallback plumbing
@@ -797,3 +1133,115 @@ def resolve_use_pallas(explicit: bool | None = None, *, n_idx: int = 512,
     if not explicit:
         return False
     return kernels_available(n_idx=n_idx, m_lock=m_lock, k_arb=k_arb)
+
+
+# ------------------------------------------- round-12 megakernel probes
+
+
+def _probe_lockv(n_val: int, n_read: int, m_lock: int, k_arb: int,
+                 hot_n: int = 0) -> bool:
+    """Compile + run lock_validate at the caller's lane geometry and check
+    it against the COMPOSITION it replaces: lock_arbitrate (itself proven
+    against the XLA chain) + the direct meta gathers/compares. Any
+    mismatch or Mosaic rejection degrades to the unfused dispatches."""
+    def probe():
+        n = 64
+        meta = ((jnp.arange(n, dtype=U32) * U32(7)) << 1) | U32(1)
+        arb = jnp.zeros((n + 1,), U32)
+        vidx = (jnp.arange(n_val, dtype=I32) * 5) % n
+        vv1 = jnp.where(jnp.arange(n_val) % 3 == 0,
+                        meta[vidx], meta[vidx] + U32(2))
+        ridx = (jnp.arange(n_read, dtype=I32) * 7) % n
+        rows = (jnp.arange(m_lock, dtype=I32) * 3) % n
+        act = jnp.arange(m_lock) % 2 == 0
+        t = jnp.asarray(2, U32)
+        arb2, grant, vbad, rmeta = lock_validate(
+            arb, meta, vidx, vv1, ridx, rows, act, t, k_arb, hot_n=hot_n)
+        arb_u, grant_u = lock_arbitrate(jnp.array(arb), rows, act, t,
+                                        k_arb, hot_n=hot_n)
+        vbad_u = (meta[vidx] != vv1).astype(U32)
+        rmeta_u = meta[ridx]
+        if not (bool(jnp.array_equal(arb2, arb_u))
+                and bool(jnp.array_equal(grant, grant_u))
+                and bool(jnp.array_equal(vbad, vbad_u))
+                and bool(jnp.array_equal(rmeta, rmeta_u))):
+            raise RuntimeError("lock_validate output != unfused pair")
+
+    return _probed(_probe_key("lockv", n_val, n_read, m_lock, k_arb,
+                              hot_n), probe)
+
+
+def _probe_gather_streams(geoms: tuple) -> bool:
+    """geoms: tuple of (k, vw) per stream — the caller's real lane
+    geometry (small tables; failure modes are construct-level)."""
+    def probe():
+        n = 64
+        tabs, idxs = [], []
+        for si, (k, vw) in enumerate(geoms):
+            tabs.append(jnp.arange(n * vw, dtype=U32) * U32(si + 1))
+            idxs.append((jnp.arange(k, dtype=I32) * (5 + si)) % n)
+        vws = tuple(vw for _, vw in geoms)
+        got = gather_streams(tuple(tabs), tuple(idxs), vws)
+        want = _xla_gather_streams(tabs, idxs, vws)
+        for g, w_ in zip(got, want):
+            if not bool(jnp.array_equal(g, w_)):
+                raise RuntimeError("gather_streams != XLA gathers")
+
+    return _probed(_probe_key("gstreams", geoms), probe)
+
+
+def _probe_scatter_streams(geoms: tuple) -> bool:
+    """geoms: tuple of (k, vw) per stream. Masked-in rows are unique per
+    stream (the engines' contract); masked lanes carry idx = -1."""
+    def probe():
+        n = 64
+        tabs, idxs, vals = [], [], []
+        for si, (k, vw) in enumerate(geoms):
+            tabs.append(jnp.arange(n * vw, dtype=U32))
+            lane = jnp.arange(k, dtype=I32)
+            uniq = (lane < n) & (lane % (2 + si % 2) == 0)
+            idxs.append(jnp.where(uniq, lane % n, -1))
+            vals.append(jnp.arange(k * vw, dtype=U32) + U32(si))
+        vws = tuple(vw for _, vw in geoms)
+        got = scatter_streams(tuple(jnp.array(tb) for tb in tabs),
+                              tuple(idxs), tuple(vals), vws)
+        want = _xla_scatter_streams(tabs, idxs, vals, vws)
+        for g, w_ in zip(got, want):
+            if not bool(jnp.array_equal(g, w_)):
+                raise RuntimeError("scatter_streams != XLA scatters")
+
+    return _probed(_probe_key("sstreams", geoms), probe)
+
+
+def fused_kernels_available(*, lockv=None, gathers=None,
+                            scatters=None) -> bool:
+    """Availability probe for the round-12 megakernels. ``lockv`` is
+    (n_val, n_read, m_lock, k_arb, hot_n) or None; ``gathers`` /
+    ``scatters`` are tuples of per-stream (k, vw) geometry or None. Same
+    degrade contract and per-(backend, interpret, geometry) cache as
+    kernels_available."""
+    ok = True
+    if lockv is not None:
+        n_val, n_read, m_lock, k_arb, hot_n = lockv
+        ok = _probe_lockv(n_val, n_read, m_lock, k_arb,
+                          hot_n=min(hot_n, 16))
+    if ok and gathers:
+        ok = _probe_gather_streams(tuple(gathers))
+    if ok and scatters:
+        ok = _probe_scatter_streams(tuple(scatters))
+    return ok
+
+
+def resolve_use_fused(explicit: bool | None = None, *, lockv=None,
+                      gathers=None, scatters=None) -> bool:
+    """Engine-builder gate for the fused wave pairs: explicit kwarg wins,
+    else the DINT_USE_FUSED env (default off — PERF.md round-12 decision
+    rule); when requested, every megakernel the engine would dispatch is
+    probed at its real geometry and any failure degrades to the unfused
+    two-kernel/XLA path (logged warning, never an exception)."""
+    if explicit is None:
+        explicit = env_use_fused()
+    if not explicit:
+        return False
+    return fused_kernels_available(lockv=lockv, gathers=gathers,
+                                   scatters=scatters)

@@ -142,7 +142,8 @@ def build_sharded_pipelined_runner(mesh: Mesh, n_shards: int,
                                    n_sub_global: int, w: int = 4096,
                                    val_words: int = 10,
                                    cohorts_per_block: int = 8, mix=None,
-                                   use_pallas=None, monitor: bool = False):
+                                   use_pallas=None, use_fused=None,
+                                   monitor: bool = False):
     """jit(shard_map(scan(step)))) over stacked carry. Same contract shape
     as the single-chip runner: returns (run, init, drain) where
       run(carry, key) -> (carry', stats [cohorts_per_block, N_STATS]
@@ -156,6 +157,14 @@ def build_sharded_pipelined_runner(mesh: Mesh, n_shards: int,
     The availability probe runs once outside shard_map; Mosaic failure
     falls back to the XLA path with a logged warning.
 
+    ``use_fused``: None = honor DINT_USE_FUSED env. Routes each device's
+    local pipe_step through the round-12 megakernels (lock_validate +
+    install_log) at the shard-local geometry (log stream width uses this
+    path's log_replicas=1 rings); the replicate fan-out stays the
+    ppermute + XLA backup apply, so REPL_PUSHED provenance is unchanged.
+    Probed once outside shard_map like use_pallas; probe failure
+    degrades to the unfused path.
+
     ``monitor``: thread the dintmon counter plane PER DEVICE — the carry
     grows a trailing stacked monitor.Counters (buf [D, N_COUNTERS]; each
     device bumps its own slice inside shard_map, with the replication
@@ -168,8 +177,13 @@ def build_sharded_pipelined_runner(mesh: Mesh, n_shards: int,
         use_pallas, n_idx=2 * w * td.K, m_lock=2 * w, k_arb=td.K_ARB)
     n_loc = n_sub_local(n_sub_global, n_shards)
     n1 = td.n_rows(n_loc) + 1
+    ew1 = logring.HDR_WORDS + val_words          # log_replicas=1 rings
+    use_fused = pg.resolve_use_fused(
+        use_fused,
+        lockv=(w * td.K, w * td.K, 2 * w, td.K_ARB, 0),
+        scatters=((2 * w, val_words), (2 * w, 1), (2 * w, ew1)))
     kw = dict(w=w, n_sub=n_loc, val_words=val_words,
-              use_pallas=use_pallas)
+              use_pallas=use_pallas, use_fused=use_fused)
 
     def local_step(state, c1, c2, key, cnt, gen_new=True):
         dev = jax.lax.axis_index(SHARD_AXIS)

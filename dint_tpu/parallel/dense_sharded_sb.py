@@ -219,7 +219,7 @@ def build_sharded_sb_runner(mesh: Mesh, n_shards: int, n_accounts: int,
                             w: int = 2048, cohorts_per_block: int = 8,
                             hot_frac=None, hot_prob=None, mix=None,
                             use_pallas=None, use_hotset=None,
-                            monitor: bool = False):
+                            use_fused=None, monitor: bool = False):
     """jit(shard_map(scan(step))). Contract mirrors the single-chip dense
     runner: (run, init, drain); stats are psummed across the mesh.
 
@@ -233,6 +233,14 @@ def build_sharded_sb_runner(mesh: Mesh, n_shards: int, n_accounts: int,
     read the local mirror, installs write through; init() attaches the
     mirror. Hot set defaults to the workload's (``hot_frac``). Outputs
     bit-identical to the default path (tests/test_hotset.py).
+
+    ``use_fused``: None = honor DINT_USE_FUSED env. Routes each owner's
+    stamp/balance gathers through ONE gather-stream lock_validate
+    dispatch and its primary install + CommitLog append through ONE
+    scatter-stream install_log dispatch (round-12 megakernels); the
+    all_to_all routing and the ppermute replicate fan-out stay
+    collective + XLA. Probed once outside shard_map; probe failure
+    degrades to the unfused path (pg.resolve_use_fused).
 
     ``monitor``: thread the dintmon counter plane PER DEVICE. Txn
     outcomes count at the source device (where the cohort completes);
@@ -259,6 +267,14 @@ def build_sharded_sb_runner(mesh: Mesh, n_shards: int, n_accounts: int,
         hot_loc = min((hot_n + d - 1) // d, n_loc)
         if use_pallas and not pg.hot_kernels_available(n_idx=d * cap):
             use_pallas = False      # partition stays; XLA serves it
+    ew1 = logring.HDR_WORDS + VW                 # replicas=1 rings
+    scat_geoms = ((d * cap, 1), (d * cap, ew1))
+    if use_hotset:
+        scat_geoms = scat_geoms + ((d * cap, 1),)
+    use_fused = pg.resolve_use_fused(
+        use_fused,
+        gathers=((d * cap, 1), (d * cap, 1), (d * cap, 1)),
+        scatters=scat_geoms)
     kw_gen = {}
     if hot_frac is not None:
         kw_gen["hot_frac"] = hot_frac
@@ -298,11 +314,21 @@ def build_sharded_sb_runner(mesh: Mesh, n_shards: int, n_accounts: int,
             r_row = _a2a(r_row, d, cap)
 
         # ---- owner side: no-wait S/X arbitration + fused read ---------
+        lanes = jnp.arange(d * cap, dtype=I32)
+        is_x = r_op == Op.ACQ_X_READ
+        is_s = r_op == Op.ACQ_S_READ
+        rows = jnp.where(r_op != 0, r_row, sent)
+        if use_fused:
+            # lock_validate megakernel: both held-stamp gathers AND the
+            # owner-side balance read as gather streams of ONE dispatch,
+            # reading the main local arrays directly (bit-identical to
+            # the hot-partitioned serving by the mirror invariant); the
+            # scatter-min arbitration below stays XLA
+            with waves.scope("dense_sharded_sb", "lock_validate"):
+                hx_raw, hs_raw, fused_bal = pg.gather_streams(
+                    (state.x_step, state.s_step, state.bal),
+                    (rows, rows, rows), (1, 1, 1))
         with waves.scope("dense_sharded_sb", "arbitrate"):
-            lanes = jnp.arange(d * cap, dtype=I32)
-            is_x = r_op == Op.ACQ_X_READ
-            is_s = r_op == Op.ACQ_S_READ
-            rows = jnp.where(r_op != 0, r_row, sent)
 
             def mirror_idx(rr, mask):
                 """Local row -> hot mirror index (tbl*hot_loc + q), -1
@@ -319,7 +345,10 @@ def build_sharded_sb_runner(mesh: Mesh, n_shards: int, n_accounts: int,
                 jnp.where(is_x, rows, oob)].min(lanes, mode="drop")
             first_s = jnp.full((m1,), BIG, I32).at[
                 jnp.where(is_s, rows, oob)].min(lanes, mode="drop")
-            if use_hotset:
+            if use_fused:
+                held_x = hx_raw == t - 1
+                held_s = hs_raw == t - 1
+            elif use_hotset:
                 held_x = pg.hot_gather(state.x_step, state.hot_x, rows,
                                        midx, 1,
                                        use_pallas=use_pallas) == t - 1
@@ -352,7 +381,9 @@ def build_sharded_sb_runner(mesh: Mesh, n_shards: int, n_accounts: int,
                 hot_s = hot_s.at[jnp.where(s_writer & (midx >= 0), midx,
                                            2 * hot_loc)].set(
                     t, mode="drop", unique_indices=True)
-            if use_hotset:
+            if use_fused:
+                raw_bal = fused_bal   # gathered in lock_validate above
+            elif use_hotset:
                 raw_bal = pg.hot_gather(state.bal, state.hot_bal, rows,
                                         midx, 1, use_pallas=use_pallas)
             else:
@@ -409,7 +440,9 @@ def build_sharded_sb_runner(mesh: Mesh, n_shards: int, n_accounts: int,
 
             irows = jnp.where(i_mask, i_row, oob)
             hot_bal = state.hot_bal
-            if use_hotset:
+            if use_fused:
+                pass    # install + log land in install_log below
+            elif use_hotset:
                 # partitioned write-through install (fused kernel on
                 # pallas, double 1-D unique-index scatter on XLA)
                 i_midx = mirror_idx(i_row, i_mask)
@@ -439,15 +472,50 @@ def build_sharded_sb_runner(mesh: Mesh, n_shards: int, n_accounts: int,
             return ring, bck
 
         # owner logs its installs (CommitLog at the primary)
-        with waves.scope("dense_sharded_sb", "install_route"):
-            newval = jnp.zeros((d * cap, VW), U32).at[:, 0].set(
-                i_bal.astype(U32))
-            log = logring.append_rep(state.log, i_mask, i_tbl,
-                                     jnp.zeros_like(i_bal),
-                                     jnp.zeros_like(i_bal, U32),
-                                     i_acc.astype(U32),
-                                     jnp.broadcast_to(t, i_mask.shape),
-                                     newval)
+        if use_fused:
+            # install_log megakernel: primary balance install, the
+            # owner's CommitLog append, and (hotset) the mirror
+            # write-through as masked row-scatter streams of ONE
+            # dispatch; the log plan is the exact append_rep plan
+            # (tables/log.plan_rep), so ring bytes match the unfused
+            # path bit for bit. Routing stays all_to_all above; the
+            # replicate fan-out below stays ppermute + XLA
+            with waves.scope("dense_sharded_sb", "install_log"):
+                newval = jnp.zeros((d * cap, VW), U32).at[:, 0].set(
+                    i_bal.astype(U32))
+                lflat, entry3, lane_counts = logring.plan_rep(
+                    state.log, i_mask, i_tbl, jnp.zeros_like(i_bal),
+                    jnp.zeros_like(i_bal, U32), i_acc.astype(U32),
+                    jnp.broadcast_to(t, i_mask.shape), newval)
+                widx = jnp.where(i_mask, i_row, -1)
+                tabs = [state.bal, state.log.entries.reshape(-1)]
+                idxs = [widx, lflat]
+                vals = [i_bal.astype(U32), entry3.reshape(-1)]
+                vws = [1, state.log.entries.shape[1]]
+                if use_hotset:
+                    i_midx = mirror_idx(i_row, i_mask)
+                    tabs += [state.hot_bal]
+                    idxs += [i_midx]
+                    vals += [i_bal.astype(U32)]
+                    vws += [1]
+                outs = pg.scatter_streams(tuple(tabs), tuple(idxs),
+                                          tuple(vals), tuple(vws))
+                bal_new = outs[0]
+                log = state.log.replace(
+                    entries=outs[1].reshape(state.log.entries.shape),
+                    head=state.log.head + lane_counts)
+                if use_hotset:
+                    hot_bal = outs[2]
+        else:
+            with waves.scope("dense_sharded_sb", "install_route"):
+                newval = jnp.zeros((d * cap, VW), U32).at[:, 0].set(
+                    i_bal.astype(U32))
+                log = logring.append_rep(state.log, i_mask, i_tbl,
+                                         jnp.zeros_like(i_bal),
+                                         jnp.zeros_like(i_bal, U32),
+                                         i_acc.astype(U32),
+                                         jnp.broadcast_to(t, i_mask.shape),
+                                         newval)
         # CommitBck x2 + CommitLog at the backups: forward applied installs
         with waves.scope("dense_sharded_sb", "replicate"):
             bck = state.bck_bal
@@ -472,13 +540,16 @@ def build_sharded_sb_runner(mesh: Mesh, n_shards: int, n_accounts: int,
         if cnt is not None and use_hotset:
             # partition accounting: 3 hot-partitioned gathers per step
             # (x/s stamps + balances), each serving (midx >= 0) lanes
-            # from the mirror; refresh = one bulk DMA per pallas gather
+            # from the mirror; refresh = one bulk DMA per pallas gather.
+            # The fused route reads the main arrays directly (no gather
+            # is partitioned), so its partition counters are zero
+            n_g = 0 if use_fused else 3
             hits = (midx >= 0).sum(dtype=I32)
             cnt = mon.bump(cnt, {
-                mon.CTR_HOT_HITS: 3 * hits,
-                mon.CTR_HOT_COLD_ROWS: 3 * (d * cap) - 3 * hits,
+                mon.CTR_HOT_HITS: n_g * hits,
+                mon.CTR_HOT_COLD_ROWS: n_g * (d * cap) - n_g * hits,
                 mon.CTR_HOT_REFRESH_BYTES:
-                    (3 * 2 * hot_loc * 4) if use_pallas else 0,
+                    (n_g * 2 * hot_loc * 4) if use_pallas else 0,
             })
         if cnt is not None:
             # txn outcomes + overflow at the SOURCE (c1 completes here);
@@ -506,6 +577,7 @@ def build_sharded_sb_runner(mesh: Mesh, n_shards: int, n_accounts: int,
                 mon.CTR_LOG_APPENDS: i_mask.sum(dtype=I32),
                 (mon.CTR_DISPATCH_PALLAS if use_pallas
                  else mon.CTR_DISPATCH_XLA): 1,
+                **({mon.CTR_FUSED_DISPATCH: 1} if use_fused else {}),
             })
             cnt = mon.gauge_max(cnt, {mon.CTR_RING_HWM: log.head.max()})
 

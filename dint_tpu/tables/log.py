@@ -93,15 +93,20 @@ def append(ring: LogRing, do_append, table_id, is_del, key_hi, key_lo, ver, val)
 # with the 3 replica entries packed side by side in the trailing word axis,
 # written by a single row-major unique-index scatter — the same scatter
 # discipline as their table installs (engines/tatp_dense.py module
-# docstring). Two measured v5e facts force this exact shape:
-#   * the [L, CAP] 2-D scatter LogRing.append pays costs ~15 ms per 16 K
-#     appends (XLA cannot prove uniqueness across the (lane, slot) index
-#     pair and serializes); a flat 1-D row scatter is ~2 ms;
+# docstring). Two facts force this exact shape:
+#   * the append is a FLAT 1-D row scatter (lane l's slots occupy rows
+#     [l*cap, (l+1)*cap)): per-lane arrival ranks make the (lane, slot)
+#     pairs provably distinct, which the flat row id turns into a plain
+#     `unique_indices=True` declaration (round 7) — ~2 ms per 16 K appends
+#     on v5e, where the historical [L, CAP] 2-D index form cost ~15 ms
+#     before it carried the uniqueness declaration. The same flat layout
+#     is what lets round 12's install_log megakernel take the append as
+#     one more masked row-scatter stream (`plan_rep` below exposes the
+#     planned rows; ops/pallas_gather.scatter_streams does the write);
 #   * a [slots, 3, EW] u32 array is tiled T(4,128) over its minor dims, so
 #     each slot physically occupies 2 KB — 34 GB at 16M slots (observed
 #     OOM). Packing replicas into the word axis pays the 128-lane padding
 #     once per slot, not once per replica.
-# Slots are flat: lane l's slots occupy rows [l*cap, (l+1)*cap).
 # --------------------------------------------------------------------------
 
 
@@ -130,11 +135,15 @@ def create_rep(lanes: int, capacity: int, val_words: int = 10,
         head=jnp.zeros((lanes,), U32), lanes=lanes, replicas=replicas)
 
 
-def append_rep(ring: RepLog, do_append, table_id, is_del, key_hi, key_lo,
-               ver, val) -> RepLog:
-    """Batched replicated append; same slot assignment as `append` (lane =
-    round-robin, slot = head[lane] + arrival rank within the lane, rings
-    wrap). One unique-index row scatter installs all replicas."""
+def plan_rep(ring: RepLog, do_append, table_id, is_del, key_hi, key_lo,
+             ver, val):
+    """Plan a replicated append without writing: returns
+    (flat [R] i32 row ids with -1 for masked lanes, entry3 [R, S*(HDR+VW)]
+    u32 replica-packed rows, lane_counts u32 [L]). `append_rep` is exactly
+    this plan + one unique-index row scatter + the head advance; the
+    fused install_log path feeds the SAME plan to
+    ops/pallas_gather.scatter_streams instead, so the ring bytes are
+    bit-identical on both routes."""
     r = do_append.shape[0]
     lanes = ring.lanes
     cap = ring.capacity
@@ -148,14 +157,27 @@ def append_rep(ring: RepLog, do_append, table_id, is_del, key_hi, key_lo,
     lane_counts = one_p.sum(axis=0).astype(U32)
     pos = ring.head[lane] + rank.astype(U32)
     slot = (pos % U32(cap)).astype(I32)
-    flat = jnp.where(do_append, lane * cap + slot, lanes * cap)
+    flat = jnp.where(do_append, lane * cap + slot, -1)
 
     flags = (is_del.astype(U32) | (table_id.astype(U32) << U32(8)))
     entry = jnp.concatenate(
         [flags[:, None], key_hi[:, None], key_lo[:, None], ver[:, None],
          val.astype(U32)], axis=1)                        # [R, HDR+VW]
     entry3 = jnp.tile(entry, (1, ring.replicas))          # [R, S*(HDR+VW)]
-    new_entries = ring.entries.at[flat].set(entry3, mode="drop",
+    return flat, entry3, lane_counts
+
+
+def append_rep(ring: RepLog, do_append, table_id, is_del, key_hi, key_lo,
+               ver, val) -> RepLog:
+    """Batched replicated append; same slot assignment as `append` (lane =
+    round-robin, slot = head[lane] + arrival rank within the lane, rings
+    wrap). One unique-index row scatter installs all replicas."""
+    flat, entry3, lane_counts = plan_rep(ring, do_append, table_id,
+                                         is_del, key_hi, key_lo, ver, val)
+    lanes = ring.lanes
+    cap = ring.capacity
+    widx = jnp.where(flat >= 0, flat, lanes * cap)
+    new_entries = ring.entries.at[widx].set(entry3, mode="drop",
                                             unique_indices=True)
     return ring.replace(entries=new_entries, head=ring.head + lane_counts)
 

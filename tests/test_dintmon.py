@@ -68,21 +68,22 @@ def test_delta_wraps_u32():
 
 
 @functools.lru_cache(maxsize=None)
-def _td_build(monitor, use_pallas=False):
+def _td_build(monitor, use_pallas=False, use_fused=False):
     from dint_tpu.engines import tatp_dense as td
 
     return td.build_pipelined_runner(
         N_SUB, w=W, val_words=VW, cohorts_per_block=CPB,
-        use_pallas=use_pallas, monitor=monitor)
+        use_pallas=use_pallas, use_fused=use_fused, monitor=monitor)
 
 
 @functools.lru_cache(maxsize=None)
-def _sb_build(monitor, use_pallas=False, use_hotset=False):
+def _sb_build(monitor, use_pallas=False, use_hotset=False,
+              use_fused=False):
     from dint_tpu.engines import smallbank_dense as sd
 
     return sd.build_pipelined_runner(
         N_ACC, w=W, cohorts_per_block=CPB, use_pallas=use_pallas,
-        use_hotset=use_hotset, monitor=monitor)
+        use_hotset=use_hotset, use_fused=use_fused, monitor=monitor)
 
 
 @functools.lru_cache(maxsize=None)
@@ -96,11 +97,12 @@ def _tp_build(monitor):
 # ---------------------------------------------------------- dense engines
 
 
-def _run_tatp_dense(monitor, blocks=3, seed=0, use_pallas=False):
+def _run_tatp_dense(monitor, blocks=3, seed=0, use_pallas=False,
+                    use_fused=False):
     from dint_tpu.engines import tatp_dense as td
 
     db = td.populate(np.random.default_rng(seed), N_SUB, val_words=VW)
-    run, init, drain = _td_build(monitor, use_pallas)
+    run, init, drain = _td_build(monitor, use_pallas, use_fused)
     carry = init(db)
     tot = np.zeros(td.N_STATS, np.int64)
     for i in range(blocks):
@@ -167,11 +169,12 @@ def test_tatp_dense_counters_bit_identical_xla_vs_pallas():
 
 
 def _run_sb_dense(monitor, blocks=3, seed=1, use_pallas=False,
-                  use_hotset=False):
+                  use_hotset=False, use_fused=False):
     from dint_tpu.engines import smallbank_dense as sd
 
     db = sd.create(N_ACC)
-    run, init, drain = _sb_build(monitor, use_pallas, use_hotset)
+    run, init, drain = _sb_build(monitor, use_pallas, use_hotset,
+                                 use_fused)
     carry = init(db)
     tot = np.zeros(sd.N_STATS, np.int64)
     for i in range(blocks):
@@ -241,6 +244,49 @@ def test_sb_dense_hot_counters_reconcile():
         {k: v for k, v in x.items() if k not in drop} == \
         {k: v for k, v in p.items() if k not in drop}
     assert base["hot_hits"] == base["hot_cold_rows"] == 0
+
+
+def test_fused_dispatch_counter_reconciles():
+    """Round-12 accounting: fused_dispatch counts every step whose paired
+    waves ran the megakernels — equal to steps on the fused route, zero
+    elsewhere — and it is counted ALONGSIDE the dispatch_xla/pallas
+    split, which must stay total (the magic gather still dispatches by
+    use_pallas). Every other counter is untouched by fusion: the
+    megakernels change dispatch boundaries, not outcomes."""
+    from dint_tpu.engines import smallbank_dense as sd  # noqa: F401
+
+    blocks = 2                       # interpret-mode steps: tier-1 budget
+    steps_t = blocks * CPB + 2       # 3-stage pipeline: 2 drain steps
+    steps_s = blocks * CPB + 1       # 2-stage pipeline: 1 drain step
+    _, tot_t, base_t = _run_tatp_dense(True, blocks=blocks)
+    _, tot_tf, fus_t = _run_tatp_dense(True, blocks=blocks,
+                                       use_fused=True)
+    assert tot_t.tolist() == tot_tf.tolist()
+    assert base_t["fused_dispatch"] == 0
+    assert fus_t["fused_dispatch"] == fus_t["steps"] == steps_t
+    assert fus_t["dispatch_xla"] == steps_t  # the split stays total
+    assert fus_t["dispatch_pallas"] == 0
+    drop = ("fused_dispatch",)
+    assert {k: v for k, v in base_t.items() if k not in drop} == \
+        {k: v for k, v in fus_t.items() if k not in drop}
+
+    _, tot_s, base_s = _run_sb_dense(True, blocks=blocks)
+    _, tot_sf, fus_s = _run_sb_dense(True, blocks=blocks,
+                                     use_fused=True)
+    assert tot_s.tolist() == tot_sf.tolist()
+    assert base_s["fused_dispatch"] == 0
+    assert fus_s["fused_dispatch"] == fus_s["steps"] == steps_s
+    assert {k: v for k, v in base_s.items() if k not in drop} == \
+        {k: v for k, v in fus_s.items() if k not in drop}
+
+    # fused x hotset: the dintcache accounting knows the fused gathers
+    # read the main arrays directly (hot_hits stays 0; only the magic /
+    # unfused lanes would count) while outcomes stay bit-identical
+    _, tot_sh, hot_s = _run_sb_dense(True, blocks=blocks,
+                                     use_hotset=True, use_fused=True)
+    assert tot_s.tolist() == tot_sh.tolist()
+    assert hot_s["fused_dispatch"] == steps_s
+    assert hot_s["hot_hits"] == hot_s["hot_cold_rows"] == 0
 
 
 # ------------------------------------------------------- generic engines

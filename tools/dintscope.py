@@ -11,7 +11,7 @@ Usage:
     python tools/dintscope.py report TRACE [--jsonl RUN.jsonl]
         [--geom w=8192 k=4 vw=10] [--steps N] [--json] [-o OUT.json]
     python tools/dintscope.py diff A B [--wave-pct 25] [--step-pct 10]
-        [--rate-pct 10] [--min-ms 0.05] [--json]
+        [--rate-pct 10] [--min-ms 0.05] [--no-alias] [--json]
     python tools/dintscope.py describe [--json]
     python tools/dintscope.py synth [-o tests/fixtures/dintscope_trace.json]
 
@@ -87,11 +87,15 @@ def cmd_diff(args) -> int:
     b = attrib.load_breakdown(args.b)
     d = attrib.diff_breakdowns(a, b, wave_pct=args.wave_pct,
                                step_pct=args.step_pct,
-                               rate_pct=args.rate_pct, min_ms=args.min_ms)
+                               rate_pct=args.rate_pct, min_ms=args.min_ms,
+                               alias=not args.no_alias)
     if args.json:
         print(json.dumps(d), flush=True)
     else:
         print(f"A = {args.a}\nB = {args.b}")
+        for dst, srcs in (d.get("aliased") or {}).items():
+            print(f"aliased: {' + '.join(srcs)} -> {dst} "
+                  "(fused megakernel; --no-alias for raw scopes)")
         for r in d["rows"]:
             if r.get("a_ms_per_step") is None \
                     and r.get("b_ms_per_step") is None:
@@ -164,6 +168,10 @@ def main(argv=None) -> int:
     p.add_argument("--step-pct", type=float, default=attrib.DEFAULT_STEP_PCT)
     p.add_argument("--rate-pct", type=float, default=attrib.DEFAULT_RATE_PCT)
     p.add_argument("--min-ms", type=float, default=attrib.DEFAULT_MIN_MS)
+    p.add_argument("--no-alias", action="store_true",
+                   help="compare raw per-scope time instead of folding "
+                        "the fused megakernels' swallowed waves into "
+                        "their successor (attrib.WAVE_ALIASES)")
     p.add_argument("--json", action="store_true")
     p.set_defaults(fn=cmd_diff)
 

@@ -25,7 +25,13 @@ Two modes:
   Emits ONE machine-parseable JSON line (artifact convention of bench.py).
 
 Usage: python tools/profile_pallas_hbm.py [K] [N_rows] [VW]
-           [--interpret] [--compare]
+           [--interpret] [--compare] [--fused] [--hot-frac F]
+
+`--fused` adds the round-12 megakernel stage: per fusion site the unfused
+PAIR of dispatches (lock_arbitrate + the meta gather/compare; the install
+scatter + the log row-scatter) vs the single fused dispatch
+(lock_validate; scatter_streams), outputs cross-checked, schema-stable
+JSON with explicit nulls when a probe or section fails.
 
 --interpret runs the kernels in pallas interpret mode (CPU-safe) at scaled-
 down geometry: this reproduces the semantics validation (outputs equal
@@ -59,6 +65,7 @@ VAL_SCALE_ROWS = 22 * (7_000_000 + 1) + 1
 
 INTERPRET = "--interpret" in sys.argv
 COMPARE = "--compare" in sys.argv
+FUSED = "--fused" in sys.argv
 HOT_FRAC = None
 if "--hot-frac" in sys.argv:
     HOT_FRAC = float(sys.argv[sys.argv.index("--hot-frac") + 1])
@@ -232,6 +239,167 @@ def _null_hot(n, vw, k, hot_frac, err):
             "equal": None, "error": repr(err)[:300]}
 
 
+def ab_fused_lockv(rng, n, m, k):
+    """Round-12 fusion site 1: the lock_arbitrate dispatch + the separate
+    meta gather/compare dispatch (the unfused PAIR, both production
+    paths) vs ONE lock_validate megakernel. Same operands, outputs
+    cross-checked element for element — the megakernel's claim is one
+    dispatch boundary and one grid, not different math."""
+    arb = jnp.zeros((n + 1,), jnp.uint32)
+    meta = jnp.asarray(rng.integers(0, 1 << 30, n, np.int64)
+                       .astype(np.uint32))
+    rows = jnp.asarray(rng.integers(0, n, m).astype(np.int32))
+    act = jnp.asarray(rng.random(m) < 0.9)
+    vidx = jnp.asarray(rng.integers(0, n, k).astype(np.int32))
+    vv1 = jnp.where(jnp.asarray(rng.random(k) < 0.5), meta[vidx],
+                    meta[vidx] + jnp.uint32(1))
+    ridx = jnp.asarray(rng.integers(0, n, k).astype(np.int32))
+    t = jnp.asarray(5, jnp.uint32)
+    print(f"--- fused lock_validate: arb [{n + 1}] u32, M={m} lanes, "
+          f"K={k} validate+read lanes ---", flush=True)
+
+    @jax.jit
+    def meta_side(meta, vidx, vv1, ridx):
+        return (meta[vidx] != vv1).astype(jnp.uint32), meta[ridx]
+
+    def unfused(a, me, vi, v1, ri, ro, ac, tt):
+        arb2, grant = pg.lock_arbitrate(jnp.array(a), ro, ac, tt, K_ARB)
+        vbad, rmeta = meta_side(me, vi, v1, ri)
+        return arb2, grant, vbad, rmeta
+
+    def fused(a, me, vi, v1, ri, ro, ac, tt):
+        return pg.lock_validate(jnp.array(a), me, vi, v1, ri, ro, ac, tt,
+                                K_ARB)
+
+    u = timeit("unfused pair (2 disp)", unfused, arb, meta, vidx, vv1,
+               ridx, rows, act, t, count=m + 2 * k)
+    f = timeit("fused lock_validate", fused, arb, meta, vidx, vv1, ridx,
+               rows, act, t, count=m + 2 * k)
+    equal = None
+    if u and f:
+        ua = unfused(arb, meta, vidx, vv1, ridx, rows, act, t)
+        fa = fused(arb, meta, vidx, vv1, ridx, rows, act, t)
+        equal = bool(all(np.array_equal(np.asarray(x), np.asarray(y))
+                         for x, y in zip(ua, fa)))
+        print(f"outputs equal: {equal}   speedup: {u / f:.2f}x",
+              flush=True)
+    return {
+        "lanes": m, "validate_lanes": k,
+        "unfused_ms": None if u is None else round(u * 1e3, 3),
+        "fused_ms": None if f is None else round(f * 1e3, 3),
+        "speedup": None if not (u and f) else round(u / f, 2),
+        "equal": equal,
+        "error": None,
+    }
+
+
+def ab_fused_install(rng, n, vw, k, log_words=3 * (20 + 4 * 10) // 4):
+    """Round-12 fusion site 2: the install scatter dispatch + the
+    replication-log row-scatter dispatch (two XLA unique-index scatters,
+    the production unfused path) vs ONE scatter_streams megakernel with
+    the table and the log ring as two aliased output streams. Masked
+    lanes carry idx = -1 on both sides."""
+    cap = max(k * 2, 256)
+    tab = jnp.asarray(rng.integers(0, 1 << 30, n * vw, np.int64)
+                      .astype(np.uint32))
+    logtab = jnp.zeros((cap * log_words,), jnp.uint32)
+    lane = np.arange(k)
+    mask = rng.random(k) < 0.8
+    perm = rng.permutation(n)[:k]          # unique rows, engine contract
+    idx = jnp.asarray(np.where(mask, perm, -1).astype(np.int32))
+    widx = jnp.asarray(np.where(mask, lane % cap, -1).astype(np.int32))
+    vals = jnp.asarray(rng.integers(0, 1 << 30, k * vw, np.int64)
+                       .astype(np.uint32))
+    entries = jnp.asarray(rng.integers(0, 1 << 30, k * log_words,
+                                       np.int64).astype(np.uint32))
+    gb = n * vw * 4 / 1e9
+    print(f"--- fused install_log: table [{n}*{vw}] u32 = {gb:.2f} GB, "
+          f"log ring [{cap}*{log_words}] u32, K={k} write lanes ---",
+          flush=True)
+
+    @jax.jit
+    def unfused(tab, logtab, idx, widx, vals, entries):
+        nrow = tab.shape[0] // vw
+        flat = jnp.where(idx >= 0, idx, nrow)
+        wf = (flat[:, None] * vw
+              + jnp.arange(vw, dtype=jnp.int32)).reshape(-1)
+        t2 = tab.at[wf].set(vals, mode="drop", unique_indices=True)
+        lf = jnp.where(widx >= 0, widx, cap)
+        wl = (lf[:, None] * log_words
+              + jnp.arange(log_words, dtype=jnp.int32)).reshape(-1)
+        l2 = logtab.at[wl].set(entries, mode="drop", unique_indices=True)
+        return t2, l2
+
+    def fused(tab, logtab, idx, widx, vals, entries):
+        return pg.scatter_streams((jnp.array(tab), jnp.array(logtab)),
+                                  (idx, widx), (vals, entries),
+                                  (vw, log_words))
+
+    u = timeit("unfused pair (2 scat)", unfused, tab, logtab, idx, widx,
+               vals, entries, count=2 * k)
+    f = timeit("fused scatter_streams", fused, tab, logtab, idx, widx,
+               vals, entries, count=2 * k)
+    equal = None
+    if u and f:
+        ua = unfused(tab, logtab, idx, widx, vals, entries)
+        fa = fused(tab, logtab, idx, widx, vals, entries)
+        equal = bool(all(np.array_equal(np.asarray(x), np.asarray(y))
+                         for x, y in zip(ua, fa)))
+        print(f"outputs equal: {equal}   speedup: {u / f:.2f}x",
+              flush=True)
+    return {
+        "rows": n, "vw": vw, "gb": round(gb, 3),
+        "log_words": log_words, "write_lanes": k,
+        "unfused_ms": None if u is None else round(u * 1e3, 3),
+        "fused_ms": None if f is None else round(f * 1e3, 3),
+        "speedup": None if not (u and f) else round(u / f, 2),
+        "equal": equal,
+        "error": None,
+    }
+
+
+def _null_fused_lockv(m, k, err):
+    return {"lanes": m, "validate_lanes": k, "unfused_ms": None,
+            "fused_ms": None, "speedup": None, "equal": None,
+            "error": repr(err)[:300]}
+
+
+def _null_fused_install(n, vw, k, err):
+    return {"rows": n, "vw": vw, "gb": round(n * vw * 4 / 1e9, 3),
+            "log_words": 3 * (20 + 4 * 10) // 4, "write_lanes": k,
+            "unfused_ms": None, "fused_ms": None, "speedup": None,
+            "equal": None, "error": repr(err)[:300]}
+
+
+def fused_stage(rng, rows, vw, k, m):
+    """The --fused section: one record per round-12 fusion site, each
+    schema-stable (explicit nulls + the failure reason when a probe or
+    section dies — downstream parsing indexes the keys unconditionally).
+    ``fused_available`` is the same probe-and-degrade verdict the engine
+    builders consult (resolve_use_fused)."""
+    try:
+        avail = pg.fused_kernels_available(
+            lockv=(min(k, 256), min(k, 256), min(m, 128), K_ARB, 0),
+            scatters=((min(k, 128), vw), (min(k, 128), 4)))
+    except Exception as e:  # noqa: BLE001 — the artifact records it
+        print(f"fused probe FAILED: {repr(e)[:300]}", flush=True)
+        avail = False
+    try:
+        lockv = ab_fused_lockv(rng, rows, m, k)
+    except Exception as e:  # noqa: BLE001
+        print(f"fused lock_validate point FAILED: {repr(e)[:300]}",
+              flush=True)
+        lockv = _null_fused_lockv(m, k, e)
+    try:
+        install = ab_fused_install(rng, rows, vw, min(k, m))
+    except Exception as e:  # noqa: BLE001
+        print(f"fused install_log point FAILED: {repr(e)[:300]}",
+              flush=True)
+        install = _null_fused_install(rows, vw, min(k, m), e)
+    return {"fused_available": avail, "lock_validate": lockv,
+            "install_log": install}
+
+
 def _null_point(n, vw, k, err):
     """Schema-stable stand-in for an ab_point that died before measuring
     (table OOM, backend crash): every key the BENCH parser reads exists,
@@ -277,6 +445,9 @@ def main():
         except Exception as e:  # noqa: BLE001
             print(f"lock point FAILED: {repr(e)[:300]}", flush=True)
             lock = _null_lock(m, e)
+        fused = None
+        if FUSED:
+            fused = fused_stage(rng, rows, VW, k, m)
         hot = None
         if HOT_FRAC is not None:
             # SmallBank geometry: the bal array is single-word rows; the
@@ -299,8 +470,15 @@ def main():
             # present iff --hot-frac was passed (schema-stable otherwise:
             # consumers see the key with explicit null)
             "hot": hot,
+            # present iff --fused was passed, same convention
+            "fused": fused,
         }
         print(json.dumps(out), flush=True)
+        return
+
+    if FUSED:
+        m = 128 if INTERPRET else 16_384
+        fused_stage(rng, N, VW, min(K, N), m)
         return
 
     if HOT_FRAC is not None:
