@@ -370,6 +370,10 @@ def _child_main():
     out["dintlint"] = lint
     if lint_err:
         out["dintlint_error"] = lint_err
+    cost, cost_err = _dintcost_snapshot()
+    out["dintcost"] = cost
+    if cost_err:
+        out["dintcost_error"] = cost_err
     if os.environ.get("DINT_BENCH_SKIP_SB") == "1":
         # short-budget retry child (see TOTAL_BUDGET_S): the parent asked
         # us to skip the secondary leg rather than lose it to the timeout
@@ -408,6 +412,33 @@ def _dintlint_snapshot():
         payload.pop("findings", None)
         return payload, None
     except Exception as e:  # noqa: BLE001 — gate failure must not kill bench
+        return None, repr(e)[:200]
+
+
+def _dintcost_snapshot():
+    """`dintcost report --all --json` in a CPU subprocess so every perf
+    artifact carries the static cost model the measurement should agree
+    with (ANALYSIS.md "Static cost model") — `dintcost diff` between two
+    artifacts then explains a throughput delta by the wave whose bytes
+    or dispatches moved. Same contract as _dintlint_snapshot: never
+    voids the measurement (DINT_BENCH_LINT=0 disables both)."""
+    if os.environ.get("DINT_BENCH_LINT", "1") == "0":
+        return None, "disabled (DINT_BENCH_LINT=0)"
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "dintcost.py")
+    timeout = float(os.environ.get("DINT_BENCH_LINT_TIMEOUT", "420"))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        c = subprocess.run([sys.executable, tool, "report", "--all",
+                            "--json"],
+                           capture_output=True, text=True, env=env,
+                           timeout=timeout)
+        lines = [ln for ln in c.stdout.splitlines() if ln.startswith("{")]
+        if not lines:
+            return None, (f"dintcost rc={c.returncode}, no JSON line; "
+                          f"stderr tail: {c.stderr.strip()[-200:]}")
+        return json.loads(lines[-1]), None
+    except Exception as e:  # noqa: BLE001 — never kills the bench
         return None, repr(e)[:200]
 
 

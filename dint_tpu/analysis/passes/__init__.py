@@ -12,9 +12,13 @@ walking machinery and ANALYSIS.md for the invariant catalogue):
   protocol           lock-dominates-write / validate-before-install /
                      abort-implies-unlock / commit-after-replication,
                      proven by the dataflow layer (analysis/dataflow.py)
+  cost_budget        derived bytes/dispatches/footprint reconcile with
+                     the waves.py ledger, stay under the registered
+                     budgets, and @fused dominates its unfused twin
+                     (analysis/cost.py — the dintcost gate)
 
 Adding a pass: write `passes/<name>.py`, decorate the entry point with
 `@core.register_pass("<name>")`, import it here.
 """
-from . import (aliasing, protocol, purity, scatter_race,  # noqa: F401
-               shard_consistency, u64_overflow)
+from . import (aliasing, cost_budget, protocol, purity,  # noqa: F401
+               scatter_race, shard_consistency, u64_overflow)

@@ -1,0 +1,173 @@
+"""dintcost gate: the derived cost model vs. ledger, budgets, dominance.
+
+dintlint proves the hot paths are safe; this pass proves they are not
+QUIETLY GETTING SLOWER. analysis/cost.py derives per-target bytes/step,
+dispatches/step and persistent footprint from the traced jaxpr; this
+pass fails closed on three checks (ANALYSIS.md "Static cost model"):
+
+  formula-mismatch        a wave's derived bytes left the tolerance band
+                          around its waves.py declared formula (after the
+                          target's registered wave_expect adjustment) —
+                          the hand ledger and the code disagree, one of
+                          them rotted
+  over-dispatch-budget    more memory-op dispatches per step than the
+                          target's registered budget: an extra unfused
+                          gather/scatter slipped into the chain
+  over-bytes-budget       derived bytes/step above the budget formula
+                          (typically "1.25*ledger"): doubled traffic
+  over-footprint-budget   donation-aware live state grew past budget: a
+                          dropped donate_argnums doubles a table
+  fused-dispatch-dominance  an @fused target no longer strictly beats
+                          its unfused twin on dispatches/step — the
+                          megakernels' whole reason to exist
+  fused-bytes-dominance   an @fused target moves >5% more bytes than its
+                          twin (the 5% rides the counter-plane deltas:
+                          held-stamp pre-read + fused_dispatch bump)
+
+Every finding names the offending wave/target in `site` and is
+silenceable through the shared dintlint allowlist with a reviewed
+reason. Budgets live in targets.TARGET_COST — the calibration ledger at
+the bottom of analysis/targets.py; recalibrating a number is a reviewed
+diff of that table, never an edit to this pass.
+"""
+from __future__ import annotations
+
+from .. import cost
+from ..core import (Finding, SEV_ERROR, SEV_WARNING, TargetTrace,
+                    register_pass)
+
+# fused targets may exceed their twin's bytes by this much: the
+# monitored variants pay the held-stamp pre-read + fused_dispatch
+# counter bump (~3% at lint geometry), which buys the dispatch win
+DOM_BYTES_EPS = 0.05
+
+
+def _budget_findings(trace: TargetTrace, meta: dict,
+                     model: cost.CostModel) -> list[Finding]:
+    out: list[Finding] = []
+    bud = meta.get("budget") or {}
+    disp = model.dispatches_per_step
+    nbytes = model.bytes_per_step
+
+    b_disp = bud.get("dispatches")
+    if b_disp is not None and disp > float(b_disp) + 1e-9:
+        out.append(Finding(
+            "cost_budget", "over-dispatch-budget", SEV_ERROR, trace.name,
+            f"{disp:g} memory-op dispatches/step, budget {b_disp:g}: an "
+            "extra unfused gather/scatter/collective entered the chain",
+            site="(per-step)",
+            suggestion="fuse the new op into an existing wave or "
+                       "recalibrate the budget in targets.TARGET_COST "
+                       "with the regression justified in the PR"))
+
+    ledger = cost.ledger_bytes(model, meta.get("wave_expect"))
+    b_bytes = cost.eval_budget_bytes(bud.get("bytes"), model.geom, ledger)
+    if b_bytes is not None and nbytes > b_bytes + 1e-6:
+        out.append(Finding(
+            "cost_budget", "over-bytes-budget", SEV_ERROR, trace.name,
+            f"{nbytes:g} derived HBM bytes/step, budget {b_bytes:g} "
+            f"(formula {bud.get('bytes')!r}, ledger {ledger:g}): row "
+            "traffic grew past the declared ledger band",
+            site="(per-step)",
+            suggestion="find the widened gather/scatter with "
+                       "`tools/dintcost.py report <target>`"))
+
+    b_fp = bud.get("footprint")
+    if b_fp is not None and model.footprint_bytes > int(b_fp):
+        out.append(Finding(
+            "cost_budget", "over-footprint-budget", SEV_ERROR, trace.name,
+            f"{model.footprint_bytes} B persistent footprint, budget "
+            f"{b_fp} B: an output buffer no longer reuses a donated "
+            "input (dropped donate_argnums?)",
+            site="(footprint)",
+            suggestion="restore the donation (aliasing pass docs) or "
+                       "recalibrate with the new allocation justified"))
+    return out
+
+
+def _reconcile_findings(trace: TargetTrace, meta: dict,
+                        model: cost.CostModel) -> list[Finding]:
+    out: list[Finding] = []
+    for c in cost.reconcile(model, wave_expect=meta.get("wave_expect"),
+                            tol_overrides=meta.get("tol")):
+        if c.ok:
+            continue
+        exp = f" (wave_expect {c.expect!r} applied)" if c.expect else ""
+        mem = "" if c.members == (c.wave,) else \
+            f" [folded: {', '.join(c.members)}]"
+        out.append(Finding(
+            "cost_budget", "formula-mismatch", SEV_ERROR, trace.name,
+            f"derived {c.derived:g} B/step vs declared "
+            f"{c.declared:g} B/step{exp} (ratio {c.ratio:.2f}, tolerance "
+            f"{c.tol:g}){mem}: the waves.py formula and the traced code "
+            "disagree — one of them rotted",
+            site=c.wave,
+            suggestion="fix the formula in monitor/waves.py if the code "
+                       "is right, or the code if the ledger is; document "
+                       "a real layout deviation as wave_expect in "
+                       "targets.TARGET_COST"))
+    return out
+
+
+def _dominance_findings(trace: TargetTrace,
+                        model: cost.CostModel) -> list[Finding]:
+    twin = cost.fused_twin(trace.name)
+    if not twin:
+        return []
+    from .. import targets as T
+    if twin not in T.TARGETS:
+        return []
+    try:
+        twin_model = cost.model_for(twin)
+    except Exception:  # noqa: BLE001 — twin untraceable here (topology)
+        return []
+    if twin_model.error:
+        return []
+    out: list[Finding] = []
+    d, dt = model.dispatches_per_step, twin_model.dispatches_per_step
+    if d >= dt:
+        out.append(Finding(
+            "cost_budget", "fused-dispatch-dominance", SEV_ERROR,
+            trace.name,
+            f"{d:g} dispatches/step vs unfused twin {twin} at {dt:g}: "
+            "the megakernels no longer shrink the dispatch chain",
+            site=twin,
+            suggestion="a wave fell out of the fused kernels — diff "
+                       f"`tools/dintcost.py report {trace.name}` against "
+                       f"the twin"))
+    b, bt = model.bytes_per_step, twin_model.bytes_per_step
+    if b > bt * (1.0 + DOM_BYTES_EPS):
+        out.append(Finding(
+            "cost_budget", "fused-bytes-dominance", SEV_ERROR, trace.name,
+            f"{b:g} B/step vs unfused twin {twin} at {bt:g}: the fused "
+            f"path moves >{DOM_BYTES_EPS:.0%} more bytes than the chain "
+            "it replaces",
+            site=twin,
+            suggestion="the fused kernels should move the SAME logical "
+                       "rows — look for a widened stream operand"))
+    return out
+
+
+@register_pass("cost_budget")
+def cost_budget(trace: TargetTrace) -> list[Finding]:
+    """Derives the target's static cost model and enforces ledger
+    reconciliation, registered budgets and fused dominance."""
+    from .. import targets as T
+    meta = T.TARGET_COST.get(trace.name)
+    if meta is None:
+        return [Finding(
+            "cost_budget", "no-budget", SEV_WARNING, trace.name,
+            "registered target has no TARGET_COST entry: its cost is "
+            "unbudgeted and regressions are invisible to CI",
+            suggestion="calibrate with `tools/dintcost.py report "
+                       f"{trace.name}` and add a _cost(...) row to the "
+                       "ledger in analysis/targets.py")]
+    model = cost.model_for(trace.name, trace)
+    if model.error:
+        return [Finding(
+            "cost_budget", "derivation-failed", SEV_ERROR, trace.name,
+            f"cost derivation failed: {model.error}")]
+    out = _reconcile_findings(trace, meta, model)
+    out += _budget_findings(trace, meta, model)
+    out += _dominance_findings(trace, model)
+    return out

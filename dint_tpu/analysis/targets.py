@@ -49,6 +49,16 @@ _LOGCAP = 128
 
 TARGETS: dict[str, Callable[[], TargetTrace]] = {}
 TARGET_DOCS: dict[str, str] = {}
+# static cost meta per target (analysis/cost.py; enforced fail-closed by
+# passes/cost_budget.py — see the budget ledger at the bottom of this
+# module and ANALYSIS.md "Static cost model"):
+#   steps        engine steps per trace (block/drain targets trace _BLK)
+#   geom         geometry vars for waves.py formulas + budget formulas
+#   wave_expect  documented per-target layout deviations from the base
+#                formula (number = scale, string = replacement formula)
+#   budget       {"dispatches": int, "bytes": formula|int, "footprint":
+#                 int} — per-step ceilings
+TARGET_COST: dict[str, dict] = {}
 # protocol flags per target (core.TargetTrace.protocol; gates the checks
 # in passes/protocol.py): "certified" = the engine closes the
 # lock/validate/install loop inside the trace; "occ" = installs must
@@ -65,11 +75,14 @@ class SkipTarget(Exception):
 
 
 def register_target(name: str, doc: str,
-                    protocol: tuple[str, ...] = ("certified",)):
+                    protocol: tuple[str, ...] = ("certified",),
+                    cost: dict | None = None):
     def deco(fn):
         TARGETS[name] = fn
         TARGET_DOCS[name] = doc
         TARGET_PROTOCOL[name] = tuple(protocol)
+        if cost is not None:
+            TARGET_COST[name] = dict(cost)
         return fn
     return deco
 
@@ -572,6 +585,136 @@ def _t_dense_sharded_sb_fused_hot() -> TargetTrace:
 def _t_dense_sharded_sb_fused_mon() -> TargetTrace:
     return _dense_sharded_sb("dense_sharded_sb/block@fused+mon",
                              use_fused=True, monitor=True)
+
+
+# -------------------------------------------------- static cost budgets
+#
+# The dintcost ledger (analysis/cost.py, gated by passes/cost_budget.py).
+# Geometry mirrors the tiny-trace knobs above and pins the engine
+# constants the waves.py formulas assume (tatp_pipeline.K = 4,
+# smallbank_pipeline.L = 3 / .VW = 2 — tests/test_dintcost.py
+# cross-checks them against the engine modules). Budgets are ceilings
+# calibrated once against the derivation at this geometry: dispatches
+# and footprint are exact (ANY extra dispatch or dropped donation
+# regresses them), bytes allow 25% over the declared waves.py ledger —
+# the same band reconciliation uses. Recalibrate with
+# `python tools/dintcost.py report <target>` and justify the diff in
+# the PR; silence a reviewed exception via the dintlint allowlist.
+
+_TD_GEOM = dict(w=_W, k=4, vw=_VW)
+_SB_GEOM = dict(w=_W, l=3, vw=2)
+_DS_GEOM = dict(w=_W, k=4, vw=_VW, d=_MESH_SHARDS)
+_DSB_GEOM = dict(w=_W, l=3, vw=2, d=_MESH_SHARDS)
+
+# wave_expect: documented layout deviations from the base formula.
+#
+# The XLA-route dintcache variants serve every partitioned table wave as
+# TWO masked full-width passes (hot partition + cold partition): logical
+# lanes stay w, but the static walker sees both gathers/scatters. The
+# VMEM-kernel hot variants (@hot+pallas, @fused+hot) do NOT double — one
+# kernel serves both partitions per wave.
+_HOT2_TD = {"dint.tatp_dense.meta_gather": 2.0,
+            "dint.tatp_dense.magic_gather": 2.0,
+            "dint.tatp_dense.install": 2.0}
+_HOT2_SB = {"dint.smallbank_dense.read": 2.0,
+            "dint.smallbank_dense.lock": 2.0,
+            "dint.smallbank_dense.install": 2.0}
+# The monitored pallas route adds the pre-kernel held-stamp read: one
+# extra full arb pass before lock_arbitrate (4 passes, not 3).
+_MONPL_TD = {"dint.tatp_dense.lock": "4*2*w*4"}
+# The sharded dense runner keeps ONE local log replica (the other two
+# ride the CommitBck/Log hops accounted under replicate), and
+# replicate's two ppermute hops each move the wL balance rows plus a
+# log append the hand formula counts once.
+_DS_EXPECT = {"dint.tatp_dense.log_append": "2*w*(20 + 4*vw)",
+              "dint.dense_sharded.replicate": 1.75}
+_DS_EXPECT_FUSED = {
+    "dint.tatp_dense.install_log": "2*w*(4 + 4*vw) + 2*w*(20 + 4*vw)",
+    "dint.dense_sharded.replicate": 1.75}
+# The dsb owner step with dintcache mirrors doubles the owner-side
+# arbitration passes (hot + cold partition of the routed slots) ...
+_DSB_HOT = {"dint.dense_sharded_sb.arbitrate": 2.0}
+# ... and the fused+hot megakernel adds hot/cold split gather streams
+# for the two balance reads (7 passes over the routed slots, not 5).
+_DSB_FUSED_HOT = {"dint.dense_sharded_sb.lock_validate": "7*2*w*l*4"}
+# The TATP fused+hot target still runs the magic read as the XLA
+# hot/cold double pass (the megakernels fuse lock+validate and
+# install+log only; meta rides lock_validate's gather streams).
+_TD_FUSED_HOT = {"dint.tatp_dense.magic_gather": 2.0}
+
+
+def _cost(geom, dispatches, footprint, *, steps=float(_BLK),
+          bytes_budget="1.25*ledger", wave_expect=None):
+    return dict(steps=float(steps), geom=dict(geom),
+                wave_expect=dict(wave_expect or {}),
+                budget=dict(dispatches=dispatches, bytes=bytes_budget,
+                            footprint=footprint))
+
+
+TARGET_COST.update({
+    # dense TATP — the fused ladder the round-12 claim rides: 9 (XLA)
+    # -> 7 (@pallas) -> 4 (@fused) dispatches/step, bytes flat
+    "tatp_dense/block": _cost(_TD_GEOM, 9, 216844),
+    "tatp_dense/block@pallas": _cost(_TD_GEOM, 7, 216844),
+    "tatp_dense/block@mon": _cost(_TD_GEOM, 11, 216952),
+    "tatp_dense/block@mon+pallas": _cost(_TD_GEOM, 10, 216952,
+                                         wave_expect=_MONPL_TD),
+    "tatp_dense/drain": _cost(_TD_GEOM, 9, 216836),
+    "tatp_dense/block@hot": _cost(_TD_GEOM, 13, 216864,
+                                  wave_expect=_HOT2_TD),
+    "tatp_dense/block@hot+pallas": _cost(_TD_GEOM, 7, 216864),
+    "tatp_dense/block@fused": _cost(_TD_GEOM, 4, 216844),
+    "tatp_dense/block@fused+hot": _cost(_TD_GEOM, 5, 216864,
+                                        wave_expect=_TD_FUSED_HOT),
+    "tatp_dense/block@fused+mon": _cost(_TD_GEOM, 7, 216952),
+    # dense SmallBank: 8 -> 5 dispatches/step under the megakernels
+    "smallbank_dense/block": _cost(_SB_GEOM, 8, 150984),
+    "smallbank_dense/block@pallas": _cost(_SB_GEOM, 8, 150984),
+    "smallbank_dense/block@mon": _cost(_SB_GEOM, 10, 151092),
+    "smallbank_dense/block@hot": _cost(_SB_GEOM, 14, 151032,
+                                       wave_expect=_HOT2_SB),
+    "smallbank_dense/block@hot+pallas": _cost(_SB_GEOM, 10, 151032),
+    "smallbank_dense/block@hot+mon": _cost(_SB_GEOM, 16, 151140,
+                                           wave_expect=_HOT2_SB),
+    "smallbank_dense/block@fused": _cost(_SB_GEOM, 5, 150984),
+    "smallbank_dense/block@fused+hot": _cost(_SB_GEOM, 7, 151032),
+    "smallbank_dense/block@fused+mon": _cost(_SB_GEOM, 7, 151092),
+    # generic pipelines: sort-bound, no formula-backed waves -> absolute
+    # bytes ceilings instead of a ledger multiple
+    "tatp_pipeline/block": _cost(_TD_GEOM, 50, 1610736022,
+                                 bytes_budget=256000),
+    "tatp_pipeline/block@mon": _cost(_TD_GEOM, 51, 1610736130,
+                                     bytes_budget=256000),
+    "smallbank_pipeline/block": _cost(_SB_GEOM, 36, 1207967480,
+                                      bytes_budget=72000),
+    "smallbank_pipeline/block@mon": _cost(_SB_GEOM, 37, 1207967588,
+                                          bytes_budget=72000),
+    # generic replicated shard step: one engine step per trace
+    "sharded/tatp": _cost(_DS_GEOM, 62, 4295279296, steps=1.0,
+                          bytes_budget=12000),
+    "sharded/smallbank": _cost(_DSB_GEOM, 30, 3221242768, steps=1.0,
+                               bytes_budget=4000),
+    # dense multi-chip TATP: 33 -> 28 dispatches/step fused
+    "dense_sharded/block": _cost(_DS_GEOM, 33, 459240,
+                                 wave_expect=_DS_EXPECT),
+    "dense_sharded/block@pallas": _cost(_DS_GEOM, 31, 459240,
+                                        wave_expect=_DS_EXPECT),
+    "dense_sharded/block@mon": _cost(_DS_GEOM, 37, 459672,
+                                     wave_expect=_DS_EXPECT),
+    "dense_sharded/block@fused": _cost(_DS_GEOM, 28, 459240,
+                                       wave_expect=_DS_EXPECT_FUSED),
+    "dense_sharded/block@fused+mon": _cost(_DS_GEOM, 33, 459672,
+                                           wave_expect=_DS_EXPECT_FUSED),
+    # dense multi-chip SmallBank: 33 -> 30 dispatches/step fused
+    "dense_sharded_sb/block": _cost(_DSB_GEOM, 33, 100676560),
+    "dense_sharded_sb/block@mon": _cost(_DSB_GEOM, 37, 100676992),
+    "dense_sharded_sb/block@hot": _cost(_DSB_GEOM, 39, 100676848,
+                                        wave_expect=_DSB_HOT),
+    "dense_sharded_sb/block@fused": _cost(_DSB_GEOM, 30, 100676560),
+    "dense_sharded_sb/block@fused+hot": _cost(
+        _DSB_GEOM, 32, 100676848, wave_expect=_DSB_FUSED_HOT),
+    "dense_sharded_sb/block@fused+mon": _cost(_DSB_GEOM, 34, 100676992),
+})
 
 
 # ----------------------------------------------------------------- API

@@ -67,7 +67,8 @@ _REGISTRY: tuple[tuple[str, str, str, str | None], ...] = (
      "pass on the pallas route)", "3*2*w*4"),
     ("tatp_dense", "rebase",
      "arb stamp rebase (full elementwise pass, once per ~16k steps — "
-     "amortizes to noise)", None),
+     "amortizes to noise; bytes unmodeled: streaming elementwise, not "
+     "row traffic)", None),
     # --- dense SmallBank (engines/smallbank_dense.py): 2-wave step -----
     ("smallbank_dense", "gen",
      "on-device cohort generation (mix + hot-set skew) — compute-only",
@@ -93,7 +94,8 @@ _REGISTRY: tuple[tuple[str, str, str, str | None], ...] = (
      "slices) — compute-only", None),
     ("tatp_pipeline", "engine_step",
      "vmapped sort-based engine step over the 3 stacked shard replicas "
-     "(the sorts + segmented reductions + table ops)", None),
+     "(the sorts + segmented reductions + table ops; bytes unmodeled: "
+     "sort-bound, no closed-form row-traffic formula)", None),
     ("tatp_pipeline", "classify",
      "per-wave outcome classification + stats emission — compute-only",
      None),
@@ -102,12 +104,12 @@ _REGISTRY: tuple[tuple[str, str, str, str | None], ...] = (
      "cohort generation + lock-slot layout — compute-only", None),
     ("smallbank_pipeline", "wave1",
      "fused lock+read at owners: vmapped engine step over the 3 stacked "
-     "replicas", None),
+     "replicas (bytes unmodeled: sort-bound)", None),
     ("smallbank_pipeline", "compute",
      "shared per-txn balance logic (compute_phase) — compute-only", None),
     ("smallbank_pipeline", "wave2",
-     "log x3 + prim/bck install + release: second vmapped engine step",
-     None),
+     "log x3 + prim/bck install + release: second vmapped engine step "
+     "(bytes unmodeled: sort-bound)", None),
     # --- multi-chip dense TATP (parallel/dense_sharded.py); the local
     # --- step re-uses the tatp_dense wave scopes ------------------------
     ("dense_sharded", "replicate",
@@ -122,18 +124,29 @@ _REGISTRY: tuple[tuple[str, str, str, str | None], ...] = (
     ("dense_sharded_sb", "route",
      "wave-1 request routing: per-owner compaction + all_to_all "
      "exchange of lock/read requests (wL lanes of key+op)", "2*w*l*8"),
+    # NOTE (dintcost audit): the owner-side formulas below were amended
+    # when analysis/cost.py started deriving the same numbers from the
+    # jaxpr — the originals pre-dated the 2x routed-slot capacity (the
+    # factor route's own formula already carried) and install_route's
+    # formula omitted the install + CommitLog bytes its doc always
+    # described. Names are append-only; formulas are declared estimates
+    # and reconciliation exists precisely so they cannot rot.
     ("dense_sharded_sb", "arbitrate",
-     "owner-side no-wait S/X arbitration + fused balance read on the "
-     "local stamp/balance arrays", "5*w*l*4"),
+     "owner-side no-wait S/X arbitration + fused balance read over the "
+     "2wL routed request slots (5 passes, like the dense lock wave)",
+     "5*2*w*l*4"),
     ("dense_sharded_sb", "reply",
      "grant/balance replies all_to_all back to sources + outcome "
-     "classification + compute_phase", "2*w*l*8"),
+     "classification + compute_phase (grant byte + balance word per "
+     "lane)", "w*l*(2 + 8)"),
     ("dense_sharded_sb", "install_route",
-     "wave-2 install routing to owners (all_to_all) + primary balance "
-     "install + the owner's CommitLog append", "w*l*8"),
+     "wave-2 install routing to owners (all_to_all over the 2wL slots) "
+     "+ primary balance install + the owner's CommitLog x3 append",
+     "2*w*l*8 + 2*w*l*4 + w*l*3*(20 + 4*vw)"),
     ("dense_sharded_sb", "replicate",
      "backup fan-out: ppermute applied installs to owner+1/+2, apply to "
-     "backup copies + append local logs", None),
+     "backup copies + append local logs (2 hops x wL balance rows + a "
+     "log append each)", "2*(w*l*4 + w*l*3*(20 + 4*vw))"),
     # --- round-12 fused megakernels (ops/pallas_gather.lock_validate +
     # --- scatter_streams); each swallows a PAIR of the waves above.
     # --- tools/dintscope.py maps the swallowed constituents onto these
@@ -157,8 +170,9 @@ _REGISTRY: tuple[tuple[str, str, str, str | None], ...] = (
      "install + log_append)", "w*l*4 + w*l*3*(20 + 4*vw)"),
     ("dense_sharded_sb", "lock_validate",
      "owner-side megakernel: arbitration stamp/balance gathers as "
-     "gather streams of ONE dispatch (swallows arbitrate's gathers)",
-     "5*w*l*4"),
+     "gather streams of ONE dispatch (swallows arbitrate's gathers; "
+     "5 passes over the 2wL routed slots, like arbitrate)",
+     "5*2*w*l*4"),
     ("dense_sharded_sb", "install_log",
      "owner-side megakernel: primary balance install + owner CommitLog "
      "append as scatter streams of ONE dispatch (swallows "

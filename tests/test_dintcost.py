@@ -1,0 +1,370 @@
+"""dintcost: the static cost model and its CI gate.
+
+Liveness: mutated mini-engine fixtures — an extra unfused scatter
+dispatch, a doubled gather width, a dropped donation — prove each
+cost_budget check fires (naming the offending wave/target) and is
+silenceable by a scoped allowlist entry; fused-pair fixtures prove the
+dominance checks in both directions. Soundness: the full 36-target
+matrix reconciles against every declared waves.py formula, stays inside
+its registered budgets with ZERO cost_budget allowlist entries, and
+every @fused target strictly dominates its unfused twin on dispatches —
+the round-12 claim as a standing CPU-only assertion. The geometry pins
+at the bottom keep the budget ledger's formula variables honest against
+the engine modules' real constants.
+"""
+import contextlib
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+import dint_tpu.parallel  # noqa: F401 — installs the jax.shard_map shim
+from dint_tpu import analysis
+from dint_tpu.analysis import allowlist as al
+from dint_tpu.analysis import core, cost
+from dint_tpu.analysis import targets as T
+from dint_tpu.monitor import waves
+
+pytestmark = pytest.mark.cost
+
+S = jax.ShapeDtypeStruct
+U32 = jnp.uint32
+I32 = jnp.int32
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ALLOW = os.path.join(REPO, "tools", "dintlint_allow.json")
+
+# ------------------------------------------------- mini-engine fixtures
+#
+# One table, one wave-scoped gather whose traffic equals the registered
+# magic_gather formula EXACTLY at this geometry (so the clean fixture
+# reconciles at ratio 1.0), one unattributed install scatter, donated
+# table. Budgets are calibrated from the clean fixture's own derived
+# model, then each mutation regresses exactly one number.
+
+WAVE = "dint.tatp_dense.magic_gather"
+GEOM = dict(w=8, k=4, vw=2)
+DECL = waves.wave_bytes(WAVE, **GEOM)          # = w*k*4 = 128 B
+NE = DECL // 4                                  # gather elements
+N = 512
+
+
+def _mini_step(wide=False, extra=False, donate=True):
+    ne = NE * (2 if wide else 1)
+
+    def raw(tab, idx, vals):
+        with jax.named_scope(WAVE):
+            got = tab[idx]                      # ne rows * 4 B
+        s = got.sum(dtype=U32)
+        tab2 = tab.at[idx[:NE]].set(vals + s, mode="drop",
+                                    unique_indices=True)
+        if extra:                               # the unfused regression
+            tab2 = tab2.at[idx[:NE]].set(vals ^ s, mode="drop",
+                                         unique_indices=True)
+        return tab2
+
+    fn = jax.jit(raw, donate_argnums=(0,)) if donate else jax.jit(raw)
+    return fn, (S((N,), U32), S((ne,), I32), S((NE,), U32))
+
+
+@contextlib.contextmanager
+def _registered(name, fn, args, meta):
+    """Temporarily add a fixture target (+ cost meta) to the registry so
+    the real analysis.run plumbing — pass, dedup, allowlist — applies."""
+    T.TARGETS[name] = lambda: core.trace_target(name, fn, args)
+    T.TARGET_DOCS[name] = "dintcost test fixture"
+    T.TARGET_PROTOCOL[name] = ()
+    if meta is not None:
+        T.TARGET_COST[name] = meta
+    try:
+        yield
+    finally:
+        for d in (T.TARGETS, T.TARGET_DOCS, T.TARGET_PROTOCOL,
+                  T.TARGET_COST):
+            d.pop(name, None)
+
+
+def _meta(budget):
+    return {"steps": 1.0, "geom": dict(GEOM), "wave_expect": {},
+            "budget": budget}
+
+
+def _clean_numbers():
+    """Derive the clean fixture once: its numbers calibrate every
+    mutated fixture's budget."""
+    fn, args = _mini_step()
+    model = cost.derive(core.trace_target("fixture_cost/_probe", fn, args),
+                        steps=1.0, geom=GEOM)
+    return model.dispatches_per_step, model.bytes_per_step, \
+        model.footprint_bytes
+
+
+def _run(name, allowlist_entries=None):
+    return analysis.run(targets=[name], passes=["cost_budget"],
+                        allowlist_entries=allowlist_entries)
+
+
+def _err_codes(findings):
+    return {f.code for f in findings
+            if f.severity == "error" and not f.suppressed}
+
+
+def test_clean_mini_engine_passes_gate():
+    disp, nbytes, fp = _clean_numbers()
+    fn, args = _mini_step()
+    name = "fixture_cost/clean"
+    with _registered(name, fn, args, _meta(
+            {"dispatches": disp, "bytes": nbytes, "footprint": fp})):
+        fs = _run(name)
+        assert not _err_codes(fs), [str(f) for f in fs]
+        # and the wave reconciles at exactly the declared formula
+        model = cost.model_for(name)
+        checks = cost.reconcile_for(name, model)
+        assert [c.wave for c in checks] == [WAVE]
+        assert checks[0].ratio == pytest.approx(1.0)
+
+
+def test_extra_scatter_fires_dispatch_budget_and_is_silenceable():
+    disp, _, fp = _clean_numbers()
+    fn, args = _mini_step(extra=True)
+    name = "fixture_cost/extra-dispatch"
+    meta = _meta({"dispatches": disp, "bytes": None, "footprint": fp})
+    with _registered(name, fn, args, meta):
+        fs = _run(name)
+        assert _err_codes(fs) == {"over-dispatch-budget"}, \
+            [str(f) for f in fs]
+        hit = [f for f in fs if f.code == "over-dispatch-budget"]
+        assert hit[0].target == name       # the offender is named
+        fs2 = _run(name, allowlist_entries=[
+            {"pass": "cost_budget", "code": "over-dispatch-budget",
+             "target": name, "reason": "fixture: regression on purpose"}])
+        assert not analysis.has_errors(fs2)
+        assert any(f.suppressed for f in fs2)
+
+
+def test_doubled_gather_fires_formula_and_bytes_budget():
+    disp, nbytes, fp = _clean_numbers()
+    fn, args = _mini_step(wide=True)
+    name = "fixture_cost/wide-gather"
+    # footprint unbudgeted: the wider idx input grows live state too, and
+    # this test isolates the byte checks
+    meta = _meta({"dispatches": disp, "bytes": nbytes, "footprint": None})
+    with _registered(name, fn, args, meta):
+        fs = _run(name)
+        assert _err_codes(fs) == {"formula-mismatch", "over-bytes-budget"}
+        mism = [f for f in fs if f.code == "formula-mismatch"]
+        assert mism[0].site == WAVE        # the offending WAVE is named
+        assert "2.00" in mism[0].message   # derived = 2x declared
+        fs2 = _run(name, allowlist_entries=[
+            {"pass": "cost_budget", "code": "formula-mismatch",
+             "target": name, "reason": "fixture: doubled on purpose"},
+            {"pass": "cost_budget", "code": "over-bytes-budget",
+             "target": name, "reason": "fixture: doubled on purpose"}])
+        assert not analysis.has_errors(fs2)
+
+
+def test_dropped_donation_fires_footprint_budget():
+    disp, nbytes, fp = _clean_numbers()
+    fn, args = _mini_step(donate=False)
+    name = "fixture_cost/no-donate"
+    meta = _meta({"dispatches": disp, "bytes": nbytes, "footprint": fp})
+    with _registered(name, fn, args, meta):
+        fs = _run(name)
+        assert _err_codes(fs) == {"over-footprint-budget"}, \
+            [str(f) for f in fs]
+        # dropping donate_argnums re-allocates the table: ~doubled state
+        model = cost.model_for(name)
+        assert model.footprint_bytes >= fp + N * 4
+        fs2 = _run(name, allowlist_entries=[
+            {"pass": "cost_budget", "code": "over-footprint-budget",
+             "target": name, "reason": "fixture: donation dropped"}])
+        assert not analysis.has_errors(fs2)
+
+
+def test_fused_dominance_fires_when_fused_loses():
+    disp, nbytes, fp = _clean_numbers()
+    twin_fn, twin_args = _mini_step()               # 2 dispatches
+    fused_fn, fused_args = _mini_step(extra=True)   # 3 dispatches: WORSE
+    twin, fused = "fixture_cost/mini", "fixture_cost/mini@fused"
+    fused_model = cost.derive(
+        core.trace_target("fixture_cost/_probe_fused", fused_fn,
+                          fused_args), steps=1.0, geom=GEOM)
+    meta = _meta({"dispatches": fused_model.dispatches_per_step,
+                  "bytes": None, "footprint": fp})
+    with _registered(twin, twin_fn, twin_args, None), \
+            _registered(fused, fused_fn, fused_args, meta):
+        fs = _run(fused)
+        assert {"fused-dispatch-dominance",
+                "fused-bytes-dominance"} <= _err_codes(fs), \
+            [str(f) for f in fs]
+        dom = [f for f in fs if f.code == "fused-dispatch-dominance"]
+        assert dom[0].site == twin         # the twin is named
+        fs2 = _run(fused, allowlist_entries=[
+            {"pass": "cost_budget", "code": "fused-dispatch-dominance",
+             "target": fused, "reason": "fixture: regression on purpose"},
+            {"pass": "cost_budget", "code": "fused-bytes-dominance",
+             "target": fused, "reason": "fixture: regression on purpose"}])
+        assert not analysis.has_errors(fs2)
+
+
+def test_fused_dominance_clean_when_fused_wins():
+    _, nbytes, fp = _clean_numbers()
+    fused_fn, fused_args = _mini_step()             # 2 dispatches: wins
+    twin_fn, twin_args = _mini_step(extra=True)     # 3 dispatches
+    twin, fused = "fixture_cost/mini2", "fixture_cost/mini2@fused"
+    meta = _meta({"dispatches": 2, "bytes": nbytes, "footprint": fp})
+    with _registered(twin, twin_fn, twin_args, None), \
+            _registered(fused, fused_fn, fused_args, meta):
+        assert not _err_codes(_run(fused))
+
+
+# ------------------------------------------------------ full-matrix gate
+
+
+def test_cost_gate_full_matrix_clean_with_zero_allowlist_entries():
+    """Acceptance: `dintcost check --all` semantics — the cost_budget
+    pass over every registered target, repo allowlist applied, zero
+    unsuppressed errors AND zero cost_budget suppressions in the file."""
+    findings = analysis.run(
+        passes=["cost_budget"],
+        allowlist_path=ALLOW if os.path.exists(ALLOW) else None)
+    errors = [str(f) for f in findings
+              if f.severity == "error" and not f.suppressed]
+    assert not errors, "dintcost gate failed:\n" + "\n".join(errors)
+    entries = al.load(ALLOW) if os.path.exists(ALLOW) else []
+    assert not [e for e in entries if e["pass"] == "cost_budget"], \
+        "the dintcost gate must hold without allowlist exceptions"
+
+
+def test_every_fused_target_dominates_its_twin():
+    """The round-12 fusion claim, statically: strictly fewer dispatches
+    per step than the unfused twin, never >5% more bytes."""
+    from dint_tpu.analysis.passes.cost_budget import DOM_BYTES_EPS
+    pairs = 0
+    for name in sorted(T.TARGETS):
+        twin = cost.fused_twin(name)
+        if not twin or twin not in T.TARGETS:
+            continue
+        try:
+            mf, mt = cost.model_for(name), cost.model_for(twin)
+        except T.SkipTarget:
+            continue
+        assert not mf.error and not mt.error, (name, mf.error, mt.error)
+        assert mf.dispatches_per_step < mt.dispatches_per_step, \
+            (name, mf.dispatches_per_step, twin, mt.dispatches_per_step)
+        assert mf.bytes_per_step <= mt.bytes_per_step \
+            * (1 + DOM_BYTES_EPS), (name, mf.bytes_per_step, twin)
+        pairs += 1
+    assert pairs >= 10        # tatp x3, sb x3, ds x2, dsb x3
+
+
+def test_reconciliation_full_matrix():
+    """Every declared waves.py formula a target exercises agrees with
+    the derived bytes within tolerance — the hand ledger cannot rot."""
+    covered = 0
+    for name in sorted(T.TARGET_COST):
+        try:
+            model = cost.model_for(name)
+        except T.SkipTarget:
+            continue
+        assert not model.error, (name, model.error)
+        for c in cost.reconcile_for(name, model):
+            assert c.ok, (name, c.wave, c.derived, c.declared,
+                          round(c.ratio, 3))
+            covered += 1
+    assert covered >= 60      # the matrix exercises the formula ledger
+
+
+def test_wave_registry_complete():
+    """Satellite contract: every registered wave has a bytes formula or
+    an explicit compute-only / unmodeled doc marker — no silently
+    unaccounted wave can enter the registry."""
+    for n in waves.ALL_WAVES:
+        doc = waves.WAVE_DOCS[n].lower()
+        assert (waves.WAVE_BYTES[n] is not None
+                or "compute-only" in doc or "unmodeled" in doc), \
+            (n, "needs a bytes formula or a compute-only/unmodeled marker")
+
+
+def test_budget_geometry_pins_engine_constants():
+    """The ledger's formula variables against the engine modules' real
+    constants — a drifted K/L/VW would silently skew every budget."""
+    from dint_tpu.engines import smallbank_pipeline, tatp_pipeline
+    assert T._TD_GEOM["k"] == tatp_pipeline.K
+    assert T._TD_GEOM["w"] == T._W and T._TD_GEOM["vw"] == T._VW
+    assert T._SB_GEOM["l"] == smallbank_pipeline.L
+    assert T._SB_GEOM["vw"] == smallbank_pipeline.VW
+    assert T._DS_GEOM["d"] == T._MESH_SHARDS
+    assert T._DSB_GEOM["d"] == T._MESH_SHARDS
+    # every registered target has a complete cost declaration
+    assert sorted(T.TARGET_COST) == sorted(T.TARGETS)
+    for name, meta in T.TARGET_COST.items():
+        assert meta["budget"]["dispatches"] is not None, name
+        assert meta["budget"]["footprint"] is not None, name
+
+
+# --------------------------------------------------------------- the CLI
+#
+# main() runs in-process (same importlib pattern as the dintlint prune
+# test) so the CLI tests reuse this process's TraceCache instead of
+# paying a fresh jax import + trace per subprocess — the exit-code and
+# JSON-line contract is identical either way.
+
+
+def _dintcost_main():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "_dintcost_cli", os.path.join(REPO, "tools", "dintcost.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.main
+
+
+def test_cli_report_check_and_diff(tmp_path, capsys):
+    """One CLI round-trip: report -o artifact + --json schema, check
+    exit 0, and diff catching an injected regression by name."""
+    main = _dintcost_main()
+    art = tmp_path / "cost.json"
+    assert main(["report", "tatp_dense/block", "tatp_dense/block@fused",
+                 "--json", "-o", str(art)]) == 0
+    payload = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert payload["metric"] == "dintcost"
+    assert isinstance(payload["schema"], int)
+    e = payload["targets"]["tatp_dense/block@fused"]
+    for k in ("bytes_per_step", "dispatches_per_step", "footprint_bytes",
+              "waves", "reconcile", "budget", "ledger_bytes"):
+        assert k in e
+    assert e["fused_twin"] == "tatp_dense/block"
+    assert all(c["ok"] for c in e["reconcile"])
+
+    assert main(["check", "--target", "tatp_dense/block@fused",
+                 "--json"]) == 0
+    out = capsys.readouterr().out
+    assert json.loads(out.strip().splitlines()[-1])["ok"] is True
+
+    mutated = json.loads(art.read_text())
+    t = mutated["targets"]["tatp_dense/block"]
+    t["dispatches_per_step"] += 1
+    wave = "dint.tatp_dense.install"
+    t["waves"][wave]["bytes_per_step"] *= 2
+    mut = tmp_path / "mutated.json"
+    mut.write_text(json.dumps(mutated))
+    assert main(["diff", str(art), str(mut), "--json"]) == 1
+    d = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    kinds = {(r["kind"], r.get("wave")) for r in d["regressions"]}
+    assert ("dispatches", None) in kinds
+    assert ("wave-bytes", wave) in kinds
+    # and A vs A is clean
+    assert main(["diff", str(art), str(art)]) == 0
+    capsys.readouterr()
+
+
+def test_cli_unknown_target_exits_2(capsys):
+    main = _dintcost_main()
+    with pytest.raises(SystemExit) as exc:
+        main(["report", "nope/bad"])
+    assert exc.value.code == 2
+    err = capsys.readouterr().err
+    assert "unknown" in err and "tatp_dense/block" in err

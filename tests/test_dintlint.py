@@ -517,6 +517,21 @@ def _broken_findings(pname):
     if pname == "protocol":
         return run_pass("protocol", _mini_occ("drop_lock"),
                         _mini_occ_args(), protocol=("certified", "occ"))
+    if pname == "cost_budget":
+        # a registered dispatch budget of 0 turns any memory op into a
+        # regression; the full gate lives in tests/test_dintcost.py
+        from dint_tpu.analysis import targets as T
+
+        def bad(tab, idx, v):
+            return tab.at[idx].set(v, mode="drop", unique_indices=True)
+        T.TARGET_COST["fixture/cost_budget"] = {
+            "steps": 1.0, "geom": {}, "wave_expect": {},
+            "budget": {"dispatches": 0, "bytes": None, "footprint": None}}
+        try:
+            return run_pass("cost_budget", bad,
+                            (S((64,), U32), S((8,), I32), S((8,), U32)))
+        finally:
+            T.TARGET_COST.pop("fixture/cost_budget", None)
     raise AssertionError(pname)
 
 
@@ -611,3 +626,50 @@ def test_allowlist_prune_drops_only_stale_entries(tmp_path):
                           "code": "nonunique-scatter",
                           "target": "fixture/scatter_race",
                           "reason": "live entry"}]   # `_used` stripped
+
+
+def _dintlint_main():
+    """Load tools/dintlint.py as a module so main() runs in-process and
+    the full-matrix prune reuses this process's TraceCache instead of
+    re-tracing 36 targets in a subprocess."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "_dintlint_cli", os.path.join(REPO, "tools", "dintlint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.main
+
+
+@pytest.mark.lint
+def test_prune_check_is_a_dry_run_that_fails_on_stale(tmp_path, capsys):
+    """--prune-allowlist --check: exit 1 on stale entries WITHOUT
+    rewriting the file; without --check the same run prunes and passes.
+    This is the CI form — allowlist rot fails the gate instead of
+    waiting for someone to remember the manual prune."""
+    main = _dintlint_main()
+    repo_allow = os.path.join(REPO, "tools", "dintlint_allow.json")
+    entries = json.loads(open(repo_allow).read())
+    entries.append({"pass": "scatter_race", "code": "no-such-code",
+                    "reason": "stale on purpose"})
+    path = tmp_path / "allow.json"
+    path.write_text(json.dumps(entries))
+    before = path.read_text()
+
+    assert main(["--prune-allowlist", "--check",
+                 "--allowlist", str(path)]) == 1
+    assert path.read_text() == before          # dry-run: NOT rewritten
+    out = capsys.readouterr().out
+    assert "NOT rewritten" in out and "no-such-code" in out
+
+    assert main(["--prune-allowlist", "--allowlist", str(path)]) == 0
+    pruned = json.loads(path.read_text())
+    assert [e["code"] for e in entries
+            if e["code"] != "no-such-code"] == [e["code"] for e in pruned]
+
+    # and pruning to a clean file means a following --check passes
+    assert main(["--prune-allowlist", "--check",
+                 "--allowlist", str(path)]) == 0
+
+    with pytest.raises(SystemExit) as exc:     # --check needs the prune
+        main(["--check", "--all"])
+    assert exc.value.code == 2

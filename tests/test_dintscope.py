@@ -118,6 +118,21 @@ def test_fixture_covers_every_registered_wave():
         sum(r["ms"] for r in bd["waves"].values()))
 
 
+def test_fixture_matches_fresh_synth(tmp_path):
+    """Drift guard: the checked-in fixture IS synthesize_trace's output
+    (the synthesizer is deterministic — durations derive from registry
+    position, no clocks). A registry change that alters synth output
+    without regenerating the fixture fails here, not three tests later
+    with a confusing coverage message."""
+    fresh = str(tmp_path / "synth.json")
+    attrib.synthesize_trace(fresh, steps=4)
+    with open(FIXTURE) as fa, open(fresh) as fb:
+        a, b = json.load(fa), json.load(fb)
+    assert a == b, (
+        "tests/fixtures/dintscope_trace.json drifted from the "
+        "synthesizer — regenerate it: python tools/dintscope.py synth")
+
+
 def test_attribution_uses_jsonl_steps_and_rates(tmp_path):
     from dint_tpu.monitor import trace as tr
 

@@ -1,0 +1,322 @@
+"""dintcost CLI: static per-wave cost model + the hardware-free perf gate.
+
+The third analysis layer (ANALYSIS.md "Static cost model"): dintlint
+proves the hot paths safe, dintscope measures them on a TPU, dintcost
+DERIVES their cost from the traced jaxpr — logical HBM bytes per wave,
+memory-op dispatches per step, donation-aware persistent footprint — and
+gates all three against the waves.py ledger and the budgets registered
+in analysis/targets.TARGET_COST. No TPU, no tunnel window: an extra
+dispatch, a doubled gather or a dropped donation fails CPU-only CI.
+
+Usage:
+    python tools/dintcost.py report TARGET [TARGET ...] [--json] [-o OUT]
+    python tools/dintcost.py report --all
+    python tools/dintcost.py check --all                 # the CI gate
+    python tools/dintcost.py check --target tatp_dense/block@fused
+        [--allowlist tools/dintlint_allow.json] [--json]
+    python tools/dintcost.py diff A.json B.json [--bytes-pct 10] [--json]
+    python tools/dintcost.py describe [--json]           # budget ledger
+
+`check` runs ONLY the cost_budget pass of the dintlint suite (same
+allowlist, same exit discipline) — `tools/dintlint.py --all` includes it
+too; this entry point exists for focused runs and the hw_round scripts.
+`diff` compares two `report -o` artifacts (e.g. across a PR) and fails
+on any dispatch/footprint growth or per-wave byte growth past the
+threshold, naming the wave and target.
+
+Exit codes: 0 ok; 1 = gate/diff failure (offenders are named); 2 usage.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# mesh targets need the same 8-device virtual CPU topology as
+# tests/conftest.py — pinned BEFORE jax initializes backends
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+from dint_tpu import analysis  # noqa: E402
+from dint_tpu.analysis import cost  # noqa: E402
+from dint_tpu.analysis import targets as T  # noqa: E402
+
+DEFAULT_ALLOWLIST = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "dintlint_allow.json")
+
+# bumped when keys of the --json payload change shape; bench artifacts
+# embed the report payload and the hw_round scripts archive it
+JSON_SCHEMA = 1
+
+DEFAULT_BYTES_PCT = 10.0
+
+
+def _target_names(args, ap) -> list[str]:
+    names = list(getattr(args, "targets", []) or []) \
+        + list(getattr(args, "target", []) or [])
+    if args.all:
+        return sorted(T.TARGETS)
+    if not names:
+        ap.error("pick targets (positional or --target) or use --all")
+    bad = [n for n in names if n not in T.TARGETS]
+    if bad:
+        ap.error("unknown target(s): " + ", ".join(repr(b) for b in bad)
+                 + "\nregistered:\n  " + "\n  ".join(sorted(T.TARGETS)))
+    return names
+
+
+def _entry(name: str) -> dict | None:
+    """One target's derived model + reconciliation + budget status, or
+    None when the target cannot trace on this topology (skipped)."""
+    try:
+        trace = T.get_trace(name)
+    except T.SkipTarget:
+        return None
+    meta = T.TARGET_COST.get(name, {})
+    model = cost.model_for(name, trace)
+    d = model.to_dict()
+    checks = cost.reconcile_for(name, model)
+    ledger = cost.ledger_bytes(model, meta.get("wave_expect"))
+    bud = dict(meta.get("budget") or {})
+    d["reconcile"] = [{
+        "wave": c.wave, "members": list(c.members),
+        "derived": round(c.derived, 2), "declared": round(c.declared, 2),
+        "ratio": round(c.ratio, 4), "tol": c.tol, "ok": c.ok,
+        "expect": None if c.expect is None else str(c.expect),
+    } for c in checks]
+    d["ledger_bytes"] = round(ledger, 2)
+    d["budget"] = {
+        "dispatches": bud.get("dispatches"),
+        "bytes_formula": bud.get("bytes"),
+        "bytes": cost.eval_budget_bytes(bud.get("bytes"), model.geom,
+                                        ledger),
+        "footprint": bud.get("footprint"),
+    }
+    twin = cost.fused_twin(name)
+    d["fused_twin"] = twin if twin in T.TARGETS else None
+    return d
+
+
+def _report_payload(names: list[str]) -> dict:
+    entries, skipped = {}, []
+    for n in names:
+        e = _entry(n)
+        if e is None:
+            skipped.append(n)
+        else:
+            entries[n] = e
+    return {"metric": "dintcost", "schema": JSON_SCHEMA,
+            "targets": entries, "skipped": skipped}
+
+
+def cmd_report(args, ap) -> int:
+    payload = _report_payload(_target_names(args, ap))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(payload, f, indent=1)
+    if args.json:
+        print(json.dumps(payload), flush=True)
+        return 0
+    for name, e in payload["targets"].items():
+        bud = e["budget"]
+        print(f"{name}  (steps/trace={e['steps']:g})")
+        print(f"  dispatches/step {e['dispatches_per_step']:g}"
+              + (f"  (budget {bud['dispatches']:g})"
+                 if bud["dispatches"] is not None else ""))
+        print(f"  bytes/step      {e['bytes_per_step']:g}"
+              + (f"  (budget {bud['bytes']:g} = {bud['bytes_formula']!r},"
+                 f" ledger {e['ledger_bytes']:g})"
+                 if bud["bytes"] is not None else ""))
+        print(f"  footprint       {e['footprint_bytes']} B "
+              f"(inputs {e['input_bytes']}, donated {e['donated_bytes']})"
+              + (f"  (budget {bud['footprint']})"
+                 if bud["footprint"] is not None else ""))
+        for w, r in e["waves"].items():
+            print(f"    {w:44s} {r['bytes_per_step']:>10g} B "
+                  f"{r['dispatches_per_step']:>6g} disp")
+        for c in e["reconcile"]:
+            mark = "ok " if c["ok"] else "FAIL"
+            exp = f" expect={c['expect']}" if c["expect"] else ""
+            print(f"    [{mark}] {c['wave']}: derived {c['derived']:g} "
+                  f"vs declared {c['declared']:g} "
+                  f"(r={c['ratio']:.2f} tol={c['tol']:g}){exp}")
+    if payload["skipped"]:
+        print("skipped (topology): " + ", ".join(payload["skipped"]))
+    return 0
+
+
+def cmd_check(args, ap) -> int:
+    names = _target_names(args, ap)
+    allowlist = args.allowlist
+    if allowlist is None and os.path.exists(DEFAULT_ALLOWLIST):
+        allowlist = DEFAULT_ALLOWLIST
+    findings = analysis.run(targets=None if args.all else names,
+                            passes=["cost_budget"],
+                            allowlist_path=allowlist)
+    failed = analysis.has_errors(findings)
+    if args.json:
+        print(json.dumps({
+            "metric": "dintcost", "schema": JSON_SCHEMA, "mode": "check",
+            "targets": names, "allowlist": allowlist,
+            "n_findings": len(findings),
+            "n_errors": sum(f.severity == "error" and not f.suppressed
+                            for f in findings),
+            "n_suppressed": sum(f.suppressed for f in findings),
+            "ok": not failed,
+            "findings": [f.to_dict() for f in findings]}), flush=True)
+    else:
+        for f in findings:
+            print(f)
+        n_err = sum(f.severity == "error" and not f.suppressed
+                    for f in findings)
+        print(f"dintcost: {len(findings)} finding(s), {n_err} error(s) "
+              f"-> {'FAIL' if failed else 'ok'}", flush=True)
+    return 1 if failed else 0
+
+
+def _load_artifact(path: str) -> dict:
+    with open(path) as f:
+        data = json.load(f)
+    # accept a bench.py artifact carrying a "dintcost" object
+    if "targets" not in data and isinstance(data.get("dintcost"), dict):
+        data = data["dintcost"]
+    if not isinstance(data.get("targets"), dict):
+        raise ValueError(f"{path}: not a dintcost report artifact "
+                         "(expected a 'targets' object — produce one "
+                         "with `dintcost report -o`)")
+    return data
+
+
+def cmd_diff(args, ap) -> int:
+    a = _load_artifact(args.a)
+    b = _load_artifact(args.b)
+    regs, rows = [], []
+    common = sorted(set(a["targets"]) & set(b["targets"]))
+    for name in common:
+        ea, eb = a["targets"][name], b["targets"][name]
+        rows.append((name, ea, eb))
+        if eb["dispatches_per_step"] > ea["dispatches_per_step"] + 1e-9:
+            regs.append({"kind": "dispatches", "target": name,
+                         "a": ea["dispatches_per_step"],
+                         "b": eb["dispatches_per_step"]})
+        if eb["footprint_bytes"] > ea["footprint_bytes"]:
+            regs.append({"kind": "footprint", "target": name,
+                         "a": ea["footprint_bytes"],
+                         "b": eb["footprint_bytes"]})
+        waves_a, waves_b = ea.get("waves", {}), eb.get("waves", {})
+        for w in sorted(set(waves_a) | set(waves_b)):
+            ba = waves_a.get(w, {}).get("bytes_per_step", 0.0)
+            bb = waves_b.get(w, {}).get("bytes_per_step", 0.0)
+            if bb > ba * (1 + args.bytes_pct / 100.0) + 1e-6:
+                regs.append({"kind": "wave-bytes", "target": name,
+                             "wave": w, "a": ba, "b": bb})
+    ok = not regs
+    if args.json:
+        print(json.dumps({
+            "metric": "dintcost", "schema": JSON_SCHEMA, "mode": "diff",
+            "a": args.a, "b": args.b, "common_targets": common,
+            "thresholds": {"bytes_pct": args.bytes_pct},
+            "ok": ok, "regressions": regs}), flush=True)
+    else:
+        print(f"A = {args.a}\nB = {args.b}")
+        for name, ea, eb in rows:
+            print(f"{name:40s} d {ea['dispatches_per_step']:g}->"
+                  f"{eb['dispatches_per_step']:g}  B "
+                  f"{ea['bytes_per_step']:g}->{eb['bytes_per_step']:g}  "
+                  f"fp {ea['footprint_bytes']}->{eb['footprint_bytes']}")
+        if ok:
+            print(f"ok: no static regression past bytes_pct="
+                  f"{args.bytes_pct:g} across {len(common)} target(s)")
+        for r in regs:
+            which = r.get("wave", r["target"])
+            print(f"REGRESSION [{r['kind']}] {r['target']} {which}: "
+                  f"{r['a']} -> {r['b']}")
+    return 0 if ok else 1
+
+
+def cmd_describe(args, ap) -> int:
+    if args.json:
+        print(json.dumps({
+            "metric": "dintcost", "schema": JSON_SCHEMA,
+            "mode": "describe",
+            "default_tol": cost.DEFAULT_TOL,
+            "targets": {n: T.TARGET_COST[n]
+                        for n in sorted(T.TARGET_COST)}}), flush=True)
+        return 0
+    print(f"dintcost budget ledger ({len(T.TARGET_COST)} targets, "
+          f"reconcile tol {cost.DEFAULT_TOL}):")
+    for n in sorted(T.TARGET_COST):
+        m = T.TARGET_COST[n]
+        bud = m.get("budget", {})
+        geom = ",".join(f"{k}={v}" for k, v in m.get("geom", {}).items())
+        print(f"  {n:40s} steps={m.get('steps'):g} "
+              f"disp<={bud.get('dispatches')} "
+              f"bytes<={bud.get('bytes')!r} fp<={bud.get('footprint')} "
+              f"[{geom}]")
+        for w, e in sorted((m.get("wave_expect") or {}).items()):
+            print(f"      expect {w} = {e!r}")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dintcost", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("report",
+                       help="derive per-target cost models (waves, "
+                            "dispatches, footprint, reconciliation)")
+    p.add_argument("targets", nargs="*", help="target names; see describe")
+    p.add_argument("--target", action="append", default=[])
+    p.add_argument("--all", action="store_true")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("-o", "--out", default=None,
+                   help="write the report artifact here (diff input)")
+    p.set_defaults(fn=cmd_report)
+
+    p = sub.add_parser("check",
+                       help="the CI gate: run the cost_budget pass with "
+                            "the dintlint allowlist")
+    p.add_argument("--target", action="append", default=[])
+    p.add_argument("--all", action="store_true")
+    p.add_argument("--allowlist", default=None,
+                   help="allowlist JSON path (default: "
+                        "tools/dintlint_allow.json when present)")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_check)
+
+    p = sub.add_parser("diff",
+                       help="regression gate between two report artifacts")
+    p.add_argument("a")
+    p.add_argument("b")
+    p.add_argument("--bytes-pct", type=float, default=DEFAULT_BYTES_PCT,
+                   help="per-wave derived-bytes growth threshold "
+                        f"(default {DEFAULT_BYTES_PCT:g}%%)")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_diff)
+
+    p = sub.add_parser("describe", help="print the budget ledger")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_describe)
+
+    args = ap.parse_args(argv)
+    try:
+        return args.fn(args, ap)
+    except (OSError, ValueError) as e:
+        print(f"dintcost: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,6 +15,7 @@ Usage:
     python tools/dintlint.py --all --time             # wall-time report
     python tools/dintlint.py --all --allowlist tools/dintlint_allow.json
     python tools/dintlint.py --prune-allowlist        # drop stale entries
+    python tools/dintlint.py --prune-allowlist --check  # dry-run: exit 1
     python tools/dintlint.py --list                   # targets + passes
 
 Exit code: 0 when no unsuppressed error-severity finding remains (warnings
@@ -23,7 +24,10 @@ and info never fail the gate), 1 otherwise, 2 on usage errors — an unknown
 traceback. The default allowlist is tools/dintlint_allow.json when it
 exists; every suppression needs a written reason and stays visible in the
 report (analysis/allowlist). `--prune-allowlist` runs the FULL matrix and
-rewrites the file dropping entries that no longer match any finding.
+rewrites the file dropping entries that no longer match any finding; with
+`--check` it rewrites NOTHING and exits 1 when stale entries exist — the
+tier-1 form (tests/test_dintlint.py), so allowlist rot fails CI instead
+of waiting for a manual prune.
 """
 from __future__ import annotations
 
@@ -108,6 +112,9 @@ def main(argv=None) -> int:
     ap.add_argument("--prune-allowlist", action="store_true",
                     help="run the FULL matrix, then rewrite the allowlist "
                          "dropping entries that matched no finding")
+    ap.add_argument("--check", action="store_true",
+                    help="with --prune-allowlist: dry-run — rewrite "
+                         "nothing, exit 1 if stale entries exist")
     ap.add_argument("--list", action="store_true",
                     help="list registered targets and passes, then exit")
     args = ap.parse_args(argv)
@@ -126,6 +133,8 @@ def main(argv=None) -> int:
         ap.error("--prune-allowlist needs the full matrix: stale-entry "
                  "detection over a subset run would drop entries whose "
                  "findings simply were not traced (drop --target/--pass)")
+    if args.check and not args.prune_allowlist:
+        ap.error("--check only modifies --prune-allowlist (dry-run)")
     if not args.all and not args.target and not args.prune_allowlist:
         ap.error("pick targets with --target/--all (or --list to see them)")
 
@@ -139,6 +148,7 @@ def main(argv=None) -> int:
         allowlist = DEFAULT_ALLOWLIST
 
     timings: dict = {}
+    stale = False
     if args.prune_allowlist:
         if not allowlist or not os.path.exists(allowlist):
             ap.error("--prune-allowlist: no allowlist file found "
@@ -147,21 +157,30 @@ def main(argv=None) -> int:
         findings = analysis.run(allowlist_entries=entries, timings=timings)
         kept, dropped = al.prune_entries(entries)
         if dropped:
-            al.save(allowlist, kept)
-            print(f"pruned {len(dropped)} stale entr"
-                  f"{'y' if len(dropped) == 1 else 'ies'} from "
-                  f"{allowlist} ({len(kept)} kept):")
+            if args.check:
+                stale = True
+                print(f"{allowlist}: {len(dropped)} stale entr"
+                      f"{'y' if len(dropped) == 1 else 'ies'} "
+                      f"({len(kept)} kept) — file NOT rewritten "
+                      "(--check); run --prune-allowlist to fix:")
+            else:
+                al.save(allowlist, kept)
+                print(f"pruned {len(dropped)} stale entr"
+                      f"{'y' if len(dropped) == 1 else 'ies'} from "
+                      f"{allowlist} ({len(kept)} kept):")
             for e in dropped:
                 print(f"  - {e['pass']}/{e['code']} "
                       f"(target={e.get('target', '*')})")
         else:
             print(f"{allowlist}: all {len(kept)} entries still match — "
                   "nothing to prune")
-        # the rewritten file is now exactly the used set: drop the
-        # unused-entry hygiene warnings from the report below
-        findings = [f for f in findings
-                    if not (f.pass_name == "allowlist"
-                            and f.code == "unused-entry")]
+        # after a real prune the file is exactly the used set: drop the
+        # unused-entry hygiene warnings from the report below (a --check
+        # dry-run keeps them — the file still holds the stale entries)
+        if not args.check:
+            findings = [f for f in findings
+                        if not (f.pass_name == "allowlist"
+                                and f.code == "unused-entry")]
     else:
         try:
             findings = analysis.run(
@@ -172,7 +191,7 @@ def main(argv=None) -> int:
         except KeyError as e:       # defense in depth; names pre-checked
             ap.error(str(e))
 
-    failed = analysis.has_errors(findings)
+    failed = analysis.has_errors(findings) or stale
     if args.json:
         payload = {
             "metric": "dintlint",
@@ -186,6 +205,7 @@ def main(argv=None) -> int:
             "n_errors": sum(f.severity == "error" and not f.suppressed
                             for f in findings),
             "n_suppressed": sum(f.suppressed for f in findings),
+            "stale_allowlist": stale,
             "ok": not failed,
             "findings": [f.to_dict() for f in findings],
         }

@@ -63,6 +63,9 @@ if [ -s dintscope_r10_off.json ] && [ -s dintscope_r10_pallas.json ]; then
     python tools/dintscope.py diff dintscope_r10_off.json \
         dintscope_r10_pallas.json | tail -8 || true
 fi
+# static prediction beside the measurement (dintcost, CPU-derived)
+JAX_PLATFORMS=cpu python tools/dintcost.py report --all --json \
+    > dintcost_r10.json 2>> dintscope_r10.log || true
 
 echo "=== stage 5: skew sweep (hot tier on vs off at each skew) ==="
 timeout 2400 python exp.py --only smallbank_skew --window 5 \

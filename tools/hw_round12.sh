@@ -74,6 +74,10 @@ if [ -s dintscope_r12_off.json ] && [ -s dintscope_r12_fused.json ]; then
         dintscope_r12_fused.json | tail -10 || true
     echo "gate exit: $?"
 fi
+# static prediction beside the measurement: the dintcost model the
+# dintscope numbers should agree with (derived on CPU, no tunnel time)
+JAX_PLATFORMS=cpu python tools/dintcost.py report --all --json \
+    > dintcost_r12.json 2>> dintscope_r12.log || true
 
 echo "=== stage 5: monitored fused run (fused_dispatch reconciliation) ==="
 # dintmon must count fused_dispatch == steps with the xla/pallas split

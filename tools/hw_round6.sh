@@ -50,5 +50,8 @@ if [ -s dintscope_r6_xla.json ] && [ -s dintscope_r6_pallas.json ]; then
     python tools/dintscope.py diff dintscope_r6_xla.json \
         dintscope_r6_pallas.json | tail -8 || true
 fi
+# static prediction beside the measurement (dintcost, CPU-derived)
+JAX_PLATFORMS=cpu python tools/dintcost.py report --all --json \
+    > dintcost_r6.json 2>> dintscope_r6.log || true
 
 echo "=== done ==="
