@@ -316,6 +316,13 @@ def _child_main():
         "lat_hist": p.get("hist"),
         "n_subscribers": N_SUBSCRIBERS,
         "width": WIDTH,
+        # mesh provenance, schema-stable: the headline legs are 1-D
+        # single-device pipelines, so both fields are EXPLICIT nulls; the
+        # 2-D (dcn x ici) measurements live in exp.py --only multihost_sb
+        # and tools/hw_multihost.sh, whose points record n_shards plus
+        # {n_hosts, n_ici, axes} parsed from DINT_BENCH_MESH
+        "n_shards": None,
+        "mesh": None,
         # which random-access backend actually ran (pallas may have been
         # requested and degraded) — A/B artifacts must be distinguishable
         "use_pallas": bool(use_pallas),

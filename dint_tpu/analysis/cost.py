@@ -32,6 +32,22 @@ traversal discipline as analysis/dataflow.py — and derive three numbers:
   output buffer NOT matched (shape+dtype) to a donated input — the
   donation-aware live-state size. Dropping a `donate_argnums` doubles
   it, which is precisely the regression this catches.
+* **Per-axis link bytes** (round 14). Each collective additionally
+  carries an interconnect attribution: the axis class it is priced on
+  ("dcn" when any of its mesh axis names contains ``dcn``, else "ici")
+  and its link bytes — the traffic the collective schedules on that
+  axis. An untiled `all_to_all` prices (n-1)/n of its operand (the self
+  shard never moves; n = the split dimension's size, which for untiled
+  a2a IS the axis size); `ppermute` prices its full operand. The slow
+  axis is deliberately conservative: a flat collective over a tuple
+  axis that includes "dcn" schedules its WHOLE exchange at DCN speed —
+  the static model cannot see a transport-level decomposition that the
+  program did not express — so an explicit hierarchical (ici, then dcn)
+  decomposition is exactly what moves bytes off the priced slow axis.
+  `Access.bytes` keeps the original whole-operand convention, so every
+  calibrated budget and waves.py reconciliation is unchanged; the
+  per-axis figures are a parallel ledger gated by the
+  hier-dcn-dominance check in passes/cost_budget.py.
 
 Scan bodies multiply their costs by the trace's `length` (the registered
 targets trace one block = `_BLK` cohorts) and the model divides by the
@@ -111,6 +127,37 @@ class Access:
     dispatches: float   # dispatch count for the whole trace
     site: str = ""
     path: str = ""
+    axis: str = ""      # collectives only: "ici" | "dcn" (slowest axis)
+    link_bytes: float = 0.0  # collectives only: bytes priced on `axis`
+
+
+def collective_axis(eqn) -> str:
+    """The axis class a collective is priced on: "dcn" when ANY of its
+    mesh axis names contains "dcn", else "ici" (the flat 1-D "shard"
+    axis is ICI-class). A tuple axis spanning both is priced "dcn" —
+    one indivisible exchange runs at the speed of its slowest link."""
+    ax = eqn.params.get("axis_name")
+    names = ax if isinstance(ax, (tuple, list)) else (ax,)
+    return "dcn" if any("dcn" in str(a) for a in names) else "ici"
+
+
+def _collective_link(eqn, nb: float) -> tuple[str, float]:
+    """(axis, link_bytes) for a collective eqn. Untiled all_to_all keeps
+    its self shard local, so (n-1)/n of the operand crosses the axis —
+    and for untiled a2a the split dimension's size IS the axis size, so
+    n reads straight off the operand aval (no mesh needed at this
+    layer). ppermute moves its whole operand."""
+    axis = collective_axis(eqn)
+    if eqn.primitive.name == "all_to_all" and \
+            not eqn.params.get("tiled", False):
+        try:
+            split = int(eqn.params.get("split_axis"))
+            n = int(eqn.invars[0].aval.shape[split])
+        except Exception:           # noqa: BLE001 — unknown layout
+            n = 0
+        if n > 1:
+            return axis, nb * (n - 1) / n
+    return axis, nb
 
 
 @dataclasses.dataclass
@@ -148,20 +195,52 @@ class CostModel:
             out[key] = out.get(key, 0.0) + a.dispatches / self.steps
         return out
 
+    def axis_bytes_per_step(self) -> dict[str, float]:
+        """Per-axis interconnect link bytes/step ({"ici": x, "dcn": y});
+        HBM gathers/scatters carry no axis and are excluded."""
+        out = {"ici": 0.0, "dcn": 0.0}
+        for a in self.accesses:
+            if a.axis:
+                out[a.axis] = out.get(a.axis, 0.0) \
+                    + a.link_bytes / self.steps
+        return out
+
+    @property
+    def dcn_bytes_per_step(self) -> float:
+        return self.axis_bytes_per_step().get("dcn", 0.0)
+
+    def wave_axis_bytes_per_step(self) -> dict[str, dict[str, float]]:
+        out: dict[str, dict[str, float]] = {}
+        for a in self.accesses:
+            if not a.axis:
+                continue
+            key = a.wave or "(unattributed)"
+            per = out.setdefault(key, {"ici": 0.0, "dcn": 0.0})
+            per[a.axis] = per.get(a.axis, 0.0) + a.link_bytes / self.steps
+        return out
+
     def to_dict(self) -> dict:
+        per_axis = self.wave_axis_bytes_per_step()
+        tot_axis = self.axis_bytes_per_step()
         return {
             "target": self.target,
             "steps": self.steps,
             "geom": dict(self.geom),
             "bytes_per_step": round(self.bytes_per_step, 2),
             "dispatches_per_step": round(self.dispatches_per_step, 3),
+            "ici_bytes_per_step": round(tot_axis.get("ici", 0.0), 2),
+            "dcn_bytes_per_step": round(tot_axis.get("dcn", 0.0), 2),
             "footprint_bytes": self.footprint_bytes,
             "input_bytes": self.input_bytes,
             "donated_bytes": self.donated_bytes,
             "waves": {
                 w: {"bytes_per_step": round(b, 2),
                     "dispatches_per_step": round(
-                        self.wave_dispatches_per_step().get(w, 0.0), 3)}
+                        self.wave_dispatches_per_step().get(w, 0.0), 3),
+                    "ici_bytes_per_step": round(
+                        per_axis.get(w, {}).get("ici", 0.0), 2),
+                    "dcn_bytes_per_step": round(
+                        per_axis.get(w, {}).get("dcn", 0.0), 2)}
                 for w, b in sorted(self.wave_bytes_per_step().items())},
             "error": self.error,
         }
@@ -266,14 +345,16 @@ class _CostWalker:
     # -- recording -------------------------------------------------------
 
     def _rec(self, eqn, kind: str, nbytes: float, mult: float,
-             record: bool, path, wave_ctx, dispatches: float = 1.0):
+             record: bool, path, wave_ctx, dispatches: float = 1.0,
+             axis: str = "", link_bytes: float = 0.0):
         if not record or mult <= 0:
             return
         self.accesses.append(Access(
             kind=kind, prim=eqn.primitive.name,
             wave=wave_of(eqn) or wave_ctx,
             bytes=nbytes * mult, dispatches=dispatches * mult,
-            site=site_of(eqn), path="/".join(path)))
+            site=site_of(eqn), path="/".join(path),
+            axis=axis, link_bytes=link_bytes * mult))
 
     # -- eqn dispatch ----------------------------------------------------
 
@@ -311,8 +392,9 @@ class _CostWalker:
         elif prim in _COLLECTIVES:
             nb = sum(_aval_bytes(v.aval) for v in eqn.invars
                      if not isinstance(v, jcore.Literal))
+            axis, link = _collective_link(eqn, float(nb))
             self._rec(eqn, "collective", float(nb), mult, record, path,
-                      wave_ctx)
+                      wave_ctx, axis=axis, link_bytes=link)
             outs = list(ins[:len(eqn.outvars)]) + \
                 [False] * max(0, len(eqn.outvars) - len(ins))
         elif prim == "dynamic_update_slice":

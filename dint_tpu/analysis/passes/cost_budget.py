@@ -23,6 +23,13 @@ pass fails closed on three checks (ANALYSIS.md "Static cost model"):
   fused-bytes-dominance   an @fused target moves >5% more bytes than its
                           twin (the 5% rides the counter-plane deltas:
                           held-stamp pre-read + fused_dispatch bump)
+  hier-dcn-dominance      a hierarchical 2-D mesh target no longer
+                          schedules STRICTLY fewer DCN-axis link bytes
+                          per step than its flat tuple-axis collective
+                          twin (targets.TARGET_FLAT_TWIN) — the whole
+                          point of routing ici-then-dcn; checked at
+                          every calibrated 2-D geometry, no allowlist
+                          entries tolerated
 
 Every finding names the offending wave/target in `site` and is
 silenceable through the shared dintlint allowlist with a reviewed
@@ -148,6 +155,34 @@ def _dominance_findings(trace: TargetTrace,
     return out
 
 
+def _hier_dominance_findings(trace: TargetTrace,
+                             model: cost.CostModel) -> list[Finding]:
+    from .. import targets as T
+    twin = T.TARGET_FLAT_TWIN.get(trace.name)
+    if not twin or twin not in T.TARGETS:
+        return []
+    try:
+        twin_model = cost.model_for(twin)
+    except Exception:  # noqa: BLE001 — twin untraceable here (topology)
+        return []
+    if twin_model.error:
+        return []
+    hier, flat = model.dcn_bytes_per_step, twin_model.dcn_bytes_per_step
+    if hier >= flat:
+        return [Finding(
+            "cost_budget", "hier-dcn-dominance", SEV_ERROR, trace.name,
+            f"{hier:g} DCN-axis link bytes/step vs flat twin {twin} at "
+            f"{flat:g}: the hierarchical (ici-then-dcn) route no longer "
+            "moves strictly fewer bytes over the slow axis — the "
+            "transport restructure lost its reason to exist",
+            site=twin,
+            suggestion="a collective fell back onto the dcn (or tuple) "
+                       "axis — diff the per-wave ici_bytes/dcn_bytes "
+                       f"blocks of `tools/dintcost.py report {trace.name} "
+                       f"{twin} --json`")]
+    return []
+
+
 @register_pass("cost_budget")
 def cost_budget(trace: TargetTrace) -> list[Finding]:
     """Derives the target's static cost model and enforces ledger
@@ -170,4 +205,5 @@ def cost_budget(trace: TargetTrace) -> list[Finding]:
     out = _reconcile_findings(trace, meta, model)
     out += _budget_findings(trace, meta, model)
     out += _dominance_findings(trace, model)
+    out += _hier_dominance_findings(trace, model)
     return out

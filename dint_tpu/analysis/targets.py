@@ -107,6 +107,23 @@ def _mesh(n: int):
     return make_mesh(n)
 
 
+def _mesh2d(n_hosts: int, n_ici: int):
+    if len(jax.devices()) < n_hosts * n_ici:
+        raise SkipTarget(
+            f"needs {n_hosts * n_ici} devices for a {n_hosts}x{n_ici} "
+            f"mesh, have {len(jax.devices())} — run under an 8-device "
+            "virtual CPU topology (tools/dintlint.py does)")
+    from ..parallel.multihost import make_mesh_2d
+    return make_mesh_2d(n_hosts, n_ici)
+
+
+# hierarchical 2-D targets -> their flat-collective twin on the SAME
+# mesh: passes/cost_budget.py fails hier-dcn-dominance unless the
+# hierarchical route derives STRICTLY fewer DCN-axis link bytes than
+# the flat lowering at every calibrated geometry (ISSUE 11's gate)
+TARGET_FLAT_TWIN: dict[str, str] = {}
+
+
 # ------------------------------------------------------------ dense TATP
 
 
@@ -587,6 +604,97 @@ def _t_dense_sharded_sb_fused_mon() -> TargetTrace:
                              use_fused=True, monitor=True)
 
 
+# ------------------------------------------- round-14 2-D (dcn x ici)
+# The multi-host cross-shard SmallBank step (parallel/multihost_sb.py)
+# and the existing multi-host TATP runner (parallel/multihost.py), both
+# over explicit (dcn, ici) mesh axes. The @flat twins lower the SAME
+# step with flat tuple-axis all_to_all collectives; cost_budget's
+# hier-dcn-dominance check (TARGET_FLAT_TWIN above) proves the
+# hierarchical route schedules strictly fewer bytes on the DCN axis.
+# Two calibrated geometries: 4x2 (the conftest topology's widest
+# >=3-host mesh) and 3x2 (the reference's 3-machine deployment shape).
+
+
+def _multihost_sb(name: str, n_hosts: int, n_ici: int,
+                  hierarchical: bool = True,
+                  monitor: bool = False) -> TargetTrace:
+    from ..parallel import multihost_sb as mhs
+    mesh = _mesh2d(n_hosts, n_ici)
+    d = n_hosts * n_ici
+    run, init, _ = mhs.build_multihost_sb_runner(
+        mesh, _N_ACCT * d, w=_W, cohorts_per_block=_BLK,
+        hierarchical=hierarchical, monitor=monitor)
+    carry = _abstract(lambda: init(mhs.create_multihost_sb(
+        mesh, _N_ACCT * d)))
+    return trace_target(name, run, (carry, _key_aval()),
+                        mesh_axes=(mhs.DCN_AXIS, mhs.ICI_AXIS))
+
+
+@register_target("multihost_sb/block",
+                 "2-D multi-host cross-shard SmallBank: hierarchical "
+                 "(ici-then-dcn) routing, host fault-domain replication",
+                 protocol=('certified', 'replicated'))
+def _t_multihost_sb() -> TargetTrace:
+    return _multihost_sb("multihost_sb/block", 4, 2)
+
+
+@register_target("multihost_sb/block@flat",
+                 "2-D multi-host SmallBank lowered with flat tuple-axis "
+                 "all_to_all (the hier-dcn-dominance baseline twin)",
+                 protocol=('certified', 'replicated'))
+def _t_multihost_sb_flat() -> TargetTrace:
+    return _multihost_sb("multihost_sb/block@flat", 4, 2,
+                         hierarchical=False)
+
+
+@register_target("multihost_sb/block@mon",
+                 "2-D multi-host SmallBank with the per-device counter "
+                 "plane (incl. the route_ici/route_dcn per-axis split)",
+                 protocol=('certified', 'replicated'))
+def _t_multihost_sb_mon() -> TargetTrace:
+    return _multihost_sb("multihost_sb/block@mon", 4, 2, monitor=True)
+
+
+@register_target("multihost_sb/block@h3",
+                 "2-D multi-host SmallBank at the reference's 3-machine "
+                 "shape (3x2 mesh), hierarchical routing",
+                 protocol=('certified', 'replicated'))
+def _t_multihost_sb_h3() -> TargetTrace:
+    return _multihost_sb("multihost_sb/block@h3", 3, 2)
+
+
+@register_target("multihost_sb/block@h3+flat",
+                 "3x2 multi-host SmallBank with flat tuple-axis "
+                 "collectives (dominance twin of @h3)",
+                 protocol=('certified', 'replicated'))
+def _t_multihost_sb_h3_flat() -> TargetTrace:
+    return _multihost_sb("multihost_sb/block@h3+flat", 3, 2,
+                         hierarchical=False)
+
+
+TARGET_FLAT_TWIN.update({
+    "multihost_sb/block": "multihost_sb/block@flat",
+    "multihost_sb/block@mon": "multihost_sb/block@flat",
+    "multihost_sb/block@h3": "multihost_sb/block@h3+flat",
+})
+
+
+@register_target("multihost/block",
+                 "2-D multi-host dense TATP: device-local pipeline + "
+                 "dcn-axis CommitBck/CommitLog fan-out (host fault "
+                 "domains)",
+                 protocol=('certified', 'occ', 'replicated'))
+def _t_multihost() -> TargetTrace:
+    from ..parallel import multihost as mhost
+    mesh = _mesh2d(4, 2)
+    run, init, _ = mhost.build_multihost_runner(
+        mesh, _N_SUB * 8, w=_W, val_words=_VW, cohorts_per_block=_BLK)
+    carry = _abstract(lambda: init(mhost.create_multihost(
+        mesh, _N_SUB * 8, val_words=_VW, log_capacity=_LOGCAP)))
+    return trace_target("multihost/block", run, (carry, _key_aval()),
+                        mesh_axes=(mhost.DCN_AXIS, mhost.ICI_AXIS))
+
+
 # -------------------------------------------------- static cost budgets
 #
 # The dintcost ledger (analysis/cost.py, gated by passes/cost_budget.py).
@@ -641,6 +749,25 @@ _DSB_FUSED_HOT = {"dint.dense_sharded_sb.lock_validate": "7*2*w*l*4"}
 # hot/cold double pass (the megakernels fuse lock+validate and
 # install+log only; meta rides lock_validate's gather streams).
 _TD_FUSED_HOT = {"dint.tatp_dense.magic_gather": 2.0}
+# 2-D mesh geometries (parallel/multihost_sb.py): d is the GLOBAL
+# device count n_hosts*n_ici — the per-step lane math is identical to
+# dense_sharded_sb at the same d, only the transport differs.
+_MHSB_GEOM = dict(w=_W, l=3, vw=2, d=8, h=4)
+_MHSB_GEOM_H3 = dict(w=_W, l=3, vw=2, d=6, h=3)
+# The @flat twins run ONE tuple-axis exchange where the hierarchical
+# formulas count two stages: route/reply halve exactly, install_route
+# falls back to dense_sharded_sb's single-exchange formula.
+_MHSB_FLAT = {
+    "dint.multihost_sb.route": 0.5,
+    "dint.multihost_sb.reply": 0.5,
+    "dint.multihost_sb.install_route":
+        "2*w*l*8 + 2*w*l*4 + w*l*3*(20 + 4*vw)"}
+# The 2-D TATP runner appends only the LOCAL log copy inside the
+# log_append wave (same deviation _DS_EXPECT documents for the 1-D
+# dense_sharded runner); its replication collectives pre-date wave
+# scoping and surface as (unattributed), hence the absolute bytes
+# budget on its row below.
+_MH_EXPECT = {"dint.tatp_dense.log_append": "2*w*(20 + 4*vw)"}
 
 
 def _cost(geom, dispatches, footprint, *, steps=float(_BLK),
@@ -656,8 +783,8 @@ TARGET_COST.update({
     # -> 7 (@pallas) -> 4 (@fused) dispatches/step, bytes flat
     "tatp_dense/block": _cost(_TD_GEOM, 9, 216844),
     "tatp_dense/block@pallas": _cost(_TD_GEOM, 7, 216844),
-    "tatp_dense/block@mon": _cost(_TD_GEOM, 11, 216952),
-    "tatp_dense/block@mon+pallas": _cost(_TD_GEOM, 10, 216952,
+    "tatp_dense/block@mon": _cost(_TD_GEOM, 11, 216960),
+    "tatp_dense/block@mon+pallas": _cost(_TD_GEOM, 10, 216960,
                                          wave_expect=_MONPL_TD),
     "tatp_dense/drain": _cost(_TD_GEOM, 9, 216836),
     "tatp_dense/block@hot": _cost(_TD_GEOM, 13, 216864,
@@ -666,28 +793,28 @@ TARGET_COST.update({
     "tatp_dense/block@fused": _cost(_TD_GEOM, 4, 216844),
     "tatp_dense/block@fused+hot": _cost(_TD_GEOM, 5, 216864,
                                         wave_expect=_TD_FUSED_HOT),
-    "tatp_dense/block@fused+mon": _cost(_TD_GEOM, 7, 216952),
+    "tatp_dense/block@fused+mon": _cost(_TD_GEOM, 7, 216960),
     # dense SmallBank: 8 -> 5 dispatches/step under the megakernels
     "smallbank_dense/block": _cost(_SB_GEOM, 8, 150984),
     "smallbank_dense/block@pallas": _cost(_SB_GEOM, 8, 150984),
-    "smallbank_dense/block@mon": _cost(_SB_GEOM, 10, 151092),
+    "smallbank_dense/block@mon": _cost(_SB_GEOM, 10, 151100),
     "smallbank_dense/block@hot": _cost(_SB_GEOM, 14, 151032,
                                        wave_expect=_HOT2_SB),
     "smallbank_dense/block@hot+pallas": _cost(_SB_GEOM, 10, 151032),
-    "smallbank_dense/block@hot+mon": _cost(_SB_GEOM, 16, 151140,
+    "smallbank_dense/block@hot+mon": _cost(_SB_GEOM, 16, 151148,
                                            wave_expect=_HOT2_SB),
     "smallbank_dense/block@fused": _cost(_SB_GEOM, 5, 150984),
     "smallbank_dense/block@fused+hot": _cost(_SB_GEOM, 7, 151032),
-    "smallbank_dense/block@fused+mon": _cost(_SB_GEOM, 7, 151092),
+    "smallbank_dense/block@fused+mon": _cost(_SB_GEOM, 7, 151100),
     # generic pipelines: sort-bound, no formula-backed waves -> absolute
     # bytes ceilings instead of a ledger multiple
     "tatp_pipeline/block": _cost(_TD_GEOM, 50, 1610736022,
                                  bytes_budget=256000),
-    "tatp_pipeline/block@mon": _cost(_TD_GEOM, 51, 1610736130,
+    "tatp_pipeline/block@mon": _cost(_TD_GEOM, 51, 1610736138,
                                      bytes_budget=256000),
     "smallbank_pipeline/block": _cost(_SB_GEOM, 36, 1207967480,
                                       bytes_budget=72000),
-    "smallbank_pipeline/block@mon": _cost(_SB_GEOM, 37, 1207967588,
+    "smallbank_pipeline/block@mon": _cost(_SB_GEOM, 37, 1207967596,
                                           bytes_budget=72000),
     # generic replicated shard step: one engine step per trace
     "sharded/tatp": _cost(_DS_GEOM, 62, 4295279296, steps=1.0,
@@ -699,21 +826,39 @@ TARGET_COST.update({
                                  wave_expect=_DS_EXPECT),
     "dense_sharded/block@pallas": _cost(_DS_GEOM, 31, 459240,
                                         wave_expect=_DS_EXPECT),
-    "dense_sharded/block@mon": _cost(_DS_GEOM, 37, 459672,
+    "dense_sharded/block@mon": _cost(_DS_GEOM, 37, 459704,
                                      wave_expect=_DS_EXPECT),
     "dense_sharded/block@fused": _cost(_DS_GEOM, 28, 459240,
                                        wave_expect=_DS_EXPECT_FUSED),
-    "dense_sharded/block@fused+mon": _cost(_DS_GEOM, 33, 459672,
+    "dense_sharded/block@fused+mon": _cost(_DS_GEOM, 33, 459704,
                                            wave_expect=_DS_EXPECT_FUSED),
     # dense multi-chip SmallBank: 33 -> 30 dispatches/step fused
     "dense_sharded_sb/block": _cost(_DSB_GEOM, 33, 100676560),
-    "dense_sharded_sb/block@mon": _cost(_DSB_GEOM, 37, 100676992),
+    "dense_sharded_sb/block@mon": _cost(_DSB_GEOM, 37, 100677024),
     "dense_sharded_sb/block@hot": _cost(_DSB_GEOM, 39, 100676848,
                                         wave_expect=_DSB_HOT),
     "dense_sharded_sb/block@fused": _cost(_DSB_GEOM, 30, 100676560),
     "dense_sharded_sb/block@fused+hot": _cost(
         _DSB_GEOM, 32, 100676848, wave_expect=_DSB_FUSED_HOT),
-    "dense_sharded_sb/block@fused+mon": _cost(_DSB_GEOM, 34, 100676992),
+    "dense_sharded_sb/block@fused+mon": _cost(_DSB_GEOM, 34, 100677024),
+    # 2-D (dcn x ici) SmallBank: the hierarchical route pays +9
+    # dispatches/step (each exchange runs ici + dcn stages) to move
+    # strictly fewer DCN-axis link bytes than its flat twin — the
+    # hier-dcn-dominance check in passes/cost_budget.py enforces that
+    # trade at BOTH calibrated geometries via TARGET_FLAT_TWIN
+    "multihost_sb/block": _cost(_MHSB_GEOM, 42, 201353056),
+    "multihost_sb/block@flat": _cost(_MHSB_GEOM, 33, 201353056,
+                                     wave_expect=_MHSB_FLAT),
+    "multihost_sb/block@mon": _cost(_MHSB_GEOM, 46, 201353984),
+    "multihost_sb/block@h3": _cost(_MHSB_GEOM_H3, 42, 151014808),
+    "multihost_sb/block@h3+flat": _cost(_MHSB_GEOM_H3, 33, 151014808,
+                                        wave_expect=_MHSB_FLAT),
+    # 2-D TATP (parallel/multihost.py, flat tuple-axis collectives):
+    # replication traffic pre-dates wave scoping -> absolute bytes
+    # ceiling like the pipeline targets, not a ledger multiple
+    "multihost/block": _cost(dict(w=_W, k=4, vw=_VW, d=8, h=4), 33,
+                             918424, bytes_budget=11000,
+                             wave_expect=_MH_EXPECT),
 })
 
 

@@ -109,6 +109,15 @@ _REGISTRY: tuple[tuple[str, str, str], ...] = (
      "(lock_validate + install_log); counted ALONGSIDE dispatch_xla/"
      "dispatch_pallas — the magic gather still dispatches by use_pallas, "
      "so fused_dispatch <= steps and the xla/pallas split stays total"),
+    ("route_ici_lanes", FLOW,
+     "routed lanes (lock requests + installs) whose owner lives on the "
+     "SAME host: the exchange crosses only the ICI axis (2-D sharded "
+     "SmallBank; route_ici_lanes + route_dcn_lanes = lock_requests + "
+     "install_writes)"),
+    ("route_dcn_lanes", FLOW,
+     "routed lanes (lock requests + installs) whose owner lives on "
+     "ANOTHER host: the exchange pays the DCN hop (2-D sharded "
+     "SmallBank)"),
 )
 
 ALL_NAMES: tuple[str, ...] = tuple(n for n, _, _ in _REGISTRY)
@@ -146,6 +155,8 @@ CTR_HOT_HITS = COUNTER_INDEX["hot_hits"]
 CTR_HOT_COLD_ROWS = COUNTER_INDEX["hot_cold_rows"]
 CTR_HOT_REFRESH_BYTES = COUNTER_INDEX["hot_refresh_bytes"]
 CTR_FUSED_DISPATCH = COUNTER_INDEX["fused_dispatch"]
+CTR_ROUTE_ICI_LANES = COUNTER_INDEX["route_ici_lanes"]
+CTR_ROUTE_DCN_LANES = COUNTER_INDEX["route_dcn_lanes"]
 
 # the subset defined with IDENTICAL semantics by the dense engines and
 # the generic sort-based pipelines: on the parity workloads

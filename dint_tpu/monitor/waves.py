@@ -178,6 +178,36 @@ _REGISTRY: tuple[tuple[str, str, str, str | None], ...] = (
      "append as scatter streams of ONE dispatch (swallows "
      "install_route's writes; routing stays all_to_all)",
      "w*l*8 + w*l*3*(20 + 4*vw)"),
+    # --- 2-D multi-host SmallBank (parallel/multihost_sb.py): the same
+    # --- cross-shard step over the (dcn x ici) mesh. Hierarchical
+    # --- routing runs each exchange TWICE (ici stage + host-aggregated
+    # --- dcn stage over the full 2wL bucket array), so the collective
+    # --- terms double vs dense_sharded_sb; the @flat twins replace them
+    # --- back via wave_expect in targets.TARGET_COST ------------------
+    ("multihost_sb", "gen",
+     "per-device cohort generation over the global keyspace — "
+     "compute-only", None),
+    ("multihost_sb", "route",
+     "wave-1 request routing: per-owner compaction + hierarchical "
+     "(ici-then-dcn) all_to_all of lock/read requests (2 exchange "
+     "stages x 2wL slots of key+op)", "2*2*w*l*8"),
+    ("multihost_sb", "arbitrate",
+     "owner-side no-wait S/X arbitration + fused balance read over the "
+     "2wL routed request slots (5 passes, like dense_sharded_sb)",
+     "5*2*w*l*4"),
+    ("multihost_sb", "reply",
+     "grant/balance replies hierarchically back to sources + outcome "
+     "classification + compute_phase (2 stages x grant byte + balance "
+     "word per lane)", "2*w*l*(2 + 8)"),
+    ("multihost_sb", "install_route",
+     "wave-2 install routing to owners (2 exchange stages over the 2wL "
+     "slots) + primary balance install + the owner's CommitLog append",
+     "2*(2*w*l*8 + 2*w*l*4) + w*l*3*(20 + 4*vw)"),
+    ("multihost_sb", "replicate",
+     "host fault-domain fan-out: ppermute applied installs to hosts "
+     "h+1/h+2 at the same chip (axis=dcn), apply to backup copies + "
+     "append local logs (2 hops x wL balance rows + a log append each)",
+     "2*(w*l*4 + w*l*3*(20 + 4*vw))"),
 )
 
 

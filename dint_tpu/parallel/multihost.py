@@ -62,6 +62,22 @@ DCN_AXIS = "dcn"
 ICI_AXIS = "ici"
 
 
+def mesh_shape_from_env(default: str = "4x2",
+                        env: str = "DINT_BENCH_MESH") -> tuple[int, int]:
+    """The bench/exp mesh-geometry knob: DINT_BENCH_MESH="HxC" (e.g.
+    "3x2" = 3 hosts x 2 chips). Bench artifacts record the parsed shape
+    next to n_shards so 2-D measurements are distinguishable from 1-D
+    runs (which record mesh: null)."""
+    import os
+    spec = os.environ.get(env) or default
+    try:
+        h, c = (int(p) for p in spec.lower().replace("*", "x").split("x"))
+    except ValueError as e:
+        raise ValueError(f"{env}={spec!r}: expected 'HxC', e.g. '4x2'") \
+            from e
+    return h, c
+
+
 def make_mesh_2d(n_hosts: int, chips_per_host: int) -> Mesh:
     """(host, chip) mesh. jax.devices() enumerates host-major under
     jax.distributed (process 0's chips first), so reshaping to
@@ -123,6 +139,12 @@ def build_multihost_runner(mesh: Mesh, n_sub_global: int, w: int = 4096,
     the replication permute pinned to the DCN axis."""
     assert 2 * w <= (1 << td.K_ARB), f"w={w} exceeds the arb slot field"
     n_hosts, n_ici = mesh.devices.shape
+    if n_hosts < 3:
+        raise ValueError(
+            f"n_hosts={n_hosts}: the replication permute pushes backups "
+            "to hosts h+1 and h+2 along the dcn axis; with fewer than 3 "
+            "hosts the +2 hop aliases the source host, so one failure "
+            "would take a primary AND its second backup together")
     n_parts = n_hosts * n_ici
     n_loc = n_sub_local(n_sub_global, n_parts)
     n1 = td.n_rows(n_loc) + 1

@@ -1,0 +1,420 @@
+"""Cross-shard SmallBank 2PC over the 2-D (dcn x ici) multi-host mesh.
+
+parallel/dense_sharded_sb.py reproduces DINT's distributed SmallBank 2PC
+(lock/read fan-out, owner arbitration, install + CommitBck x2/CommitLog
+x3) over ONE flat ICI axis — a single host. parallel/multihost.py has
+the 2-D (host, chip) mesh but only runs device-local TATP on it. This
+module is the junction: the SAME cross-shard transaction step, with the
+transport restructured for a mesh whose major axis is the data-center
+network (ROADMAP open item "true cross-shard distributed transactions,
+then take them off one host"; FaSST OSDI'16 design space — remote bytes
+are the budget, so route so only truly-remote lanes pay them):
+
+  * **Hierarchical routing.** A routed bucket array [D*cap] (D = H*C
+    global shards) reshaped to [H, C, cap] is exchanged in two stages:
+    an ICI `all_to_all` inside each host (split/concat the CHIP dim),
+    then ONE host-aggregated DCN `all_to_all` (split/concat the HOST
+    dim). Host-local lanes never leave the ICI stage — `all_to_all`
+    keeps the self shard local, so the DCN stage moves (H-1)/H of the
+    operand instead of scheduling the full (D-1)/D exchange on the slow
+    axis. The composition is a pure permutation: on device (h, c) the
+    received flat index hs*C*cap + cs*cap + p equals the 1-D runner's
+    s'*cap + p for source shard s' = hs*C + cs — bit-identical owner
+    arbitration by construction (pinned in tests/test_multihost_sb.py).
+    ``hierarchical=False`` lowers the SAME step with flat tuple-axis
+    ``all_to_all(("dcn", "ici"))`` collectives: the A/B twin dintcost's
+    hier-dcn-dominance gate compares against (analysis/cost.py prices a
+    dcn-bearing collective's link bytes on the slow axis).
+  * **Host fault domains.** The CommitBck x2 / CommitLog x3 replicate
+    fan-out moves to ``ppermute(axis="dcn")`` at the same ICI
+    coordinate — the 3 replicas of every row live on 3 DIFFERENT HOSTS,
+    the reference's machine-failure guarantee and the same placement as
+    multihost.py. (This is the one deliberate divergence from the 1-D
+    runner: stats and primary state are bit-identical, backup/log
+    PLACEMENT is not — replicas sit at (h+1, c)/(h+2, c) instead of
+    global shards s+1/s+2.)
+  * **Hierarchical reductions.** The commit/abort vote stats psum runs
+    ici-then-dcn (integer adds — associative, so bit-identical to the
+    flat psum), and the monitor plane gains per-axis route counters
+    (route_ici_lanes / route_dcn_lanes) so the host-locality of the
+    traffic is observable, not just priced.
+
+Requires n_hosts >= 3 (the +2 dcn hop would alias the source on a
+2-host mesh and double-log — same rule as multihost.py). XLA-only step:
+the pallas/hotset/fused levers of the 1-D runner are orthogonal to the
+transport and stay on the flat-axis path (PERF.md round 14).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..engines.smallbank_pipeline import (L, TS_AMT_MAX, VW,
+                                          compute_phase, gen_cohort,
+                                          _lock_slots)
+from ..engines.types import Op
+from ..monitor import counters as mon
+from ..monitor import waves
+from ..tables import log as logring
+from .dense_sharded_sb import (N_BCK, SBCtx, SBShard, _empty_sb_ctx,
+                               _positions, _route, _stats_of,
+                               m1_local, n_acct_local)
+from .multihost import DCN_AXIS, ICI_AXIS, make_mesh_2d   # noqa: F401
+from .sharded import pcast_varying
+
+I32 = jnp.int32
+U32 = jnp.uint32
+
+BIG = jnp.int32(1 << 30)
+
+
+def create_multihost_sb(mesh: Mesh, n_accounts: int,
+                        init_balance: int = 1000, log_lanes: int = 16,
+                        log_capacity: int = 1 << 16) -> SBShard:
+    """Stacked per-device state [H, C, ...]: device (h, c) is primary for
+    global shard h*C + c of the round-robin account partition (the same
+    partition as create_sharded_sb at D = H*C)."""
+    n_hosts, n_ici = mesh.devices.shape
+    if n_hosts < 3:
+        raise ValueError("multihost replication needs >= 3 hosts "
+                         "(reference topology: 3 server machines; with 2 "
+                         "the +2 dcn hop aliases the source)")
+    d = n_hosts * n_ici
+    m1 = m1_local(n_accounts, d)
+    bal = jnp.full((m1,), np.uint32(init_balance), U32).at[-1].set(0)
+    one = SBShard(
+        bal=bal,
+        bck_bal=jnp.concatenate([bal, bal]),
+        x_step=jnp.zeros((m1,), U32),
+        s_step=jnp.zeros((m1,), U32),
+        step=jnp.asarray(2, U32),
+        log=logring.create_rep(log_lanes, log_capacity, VW, replicas=1))
+    shard = NamedSharding(mesh, P(DCN_AXIS, ICI_AXIS))
+    return jax.tree.map(
+        lambda x: jax.device_put(
+            jnp.broadcast_to(x[None, None], (n_hosts, n_ici) + x.shape),
+            shard), one)
+
+
+def total_balance_global(state: SBShard):
+    """Host-side: global balance sum over all primaries (i32 wraparound,
+    matching STAT_BAL_DELTA accounting; [H, C, m1] leaves)."""
+    bal = np.asarray(state.bal)
+    return int(bal.reshape(-1, bal.shape[-1])[:, :-1]
+               .astype(np.uint32).view(np.int32).sum(dtype=np.int32))
+
+
+def build_multihost_sb_runner(mesh: Mesh, n_accounts: int, w: int = 2048,
+                              cohorts_per_block: int = 8, hot_frac=None,
+                              hot_prob=None, mix=None,
+                              hierarchical: bool = False,
+                              monitor: bool = False):
+    """jit(shard_map(scan(step))) over the 2-D mesh. Contract mirrors
+    build_sharded_sb_runner: (run, init, drain); stats psummed ici then
+    dcn. ``hierarchical`` picks the two-stage (ici, dcn) exchange or the
+    flat tuple-axis all_to_all — outputs are bit-identical either way,
+    only the transport differs. The default follows PERF.md round 14's
+    pre-registered rule: hierarchical derives strictly fewer DCN-axis
+    bytes at every calibrated geometry (enforced by hier-dcn-dominance)
+    but costs ~3.4% on the virtual mesh where both axes are the same
+    fabric, so it stays OPT-IN until a dcn-bearing hardware A/B
+    (tools/hw_multihost.sh) lands."""
+    n_hosts, n_ici = mesh.devices.shape
+    if n_hosts < 3:
+        raise ValueError("multihost replication needs >= 3 hosts "
+                         "(reference topology: 3 server machines; with 2 "
+                         "the +2 dcn hop aliases the source)")
+    d = n_hosts * n_ici
+    n_loc = n_acct_local(n_accounts, d)
+    m1 = m1_local(n_accounts, d)
+    sent = m1 - 1
+    oob = m1
+    cap = 2 * ((w * L + d - 1) // d)
+    kw_gen = {}
+    if hot_frac is not None:
+        kw_gen["hot_frac"] = hot_frac
+    if hot_prob is not None:
+        kw_gen["hot_prob"] = hot_prob
+
+    def _exchange(x):
+        """[D*cap] bucket exchange. Hierarchical: ICI a2a inside each
+        host, then ONE dcn a2a of the host-aggregated buckets (host-local
+        lanes stay on ICI). Flat: one tuple-axis a2a, dcn-major shard
+        order — both are the 1-D runner's permutation exactly."""
+        if hierarchical:
+            x3 = x.reshape(n_hosts, n_ici, cap)
+            x3 = jax.lax.all_to_all(x3, ICI_AXIS, 1, 1, tiled=False)
+            x3 = jax.lax.all_to_all(x3, DCN_AXIS, 0, 0, tiled=False)
+            return x3.reshape(d * cap)
+        return jax.lax.all_to_all(x.reshape(d, cap),
+                                  (DCN_AXIS, ICI_AXIS), 0, 0,
+                                  tiled=False).reshape(d * cap)
+
+    def local_step(state: SBShard, c1: SBCtx, key, cnt, gen_new=True):
+        h = jax.lax.axis_index(DCN_AXIS)
+        c = jax.lax.axis_index(ICI_AXIS)
+        dev = h * n_ici + c             # global shard id, dcn-major
+        t = state.step
+        kgen, kamt = jax.random.split(jax.random.fold_in(key, dev))
+
+        # ---- wave 1: generate + route lock/read requests to owners ----
+        if gen_new:
+            with waves.scope("multihost_sb", "gen"):
+                ttype, a1, a2 = gen_cohort(kgen, w, n_accounts, mix=mix,
+                                           **kw_gen)
+                l_op, l_tb, l_ac = _lock_slots(ttype, a1, a2)
+        else:
+            ttype = jnp.zeros((w,), I32)
+            l_op = jnp.zeros((w, L), I32)
+            l_tb = jnp.zeros((w, L), I32)
+            l_ac = jnp.zeros((w, L), I32)
+        ts_amt = jax.random.randint(kamt, (w,), -TS_AMT_MAX,
+                                    TS_AMT_MAX + 1, dtype=I32)
+
+        with waves.scope("multihost_sb", "route"):
+            active = (l_op != 0).reshape(-1)
+            dest = (l_ac.reshape(-1) % d).astype(I32)
+            row_loc = (l_tb.reshape(-1) * n_loc
+                       + l_ac.reshape(-1) // d).astype(I32)
+            pos = _positions(dest, active, d)
+            valid = active & (pos < cap)
+
+            r_op, r_row = _route(dest, pos, valid, cap, d,
+                                 [l_op.reshape(-1), row_loc])
+            r_op = _exchange(r_op)
+            r_row = _exchange(r_row)
+
+        # ---- owner side: no-wait S/X arbitration + fused read ---------
+        lanes = jnp.arange(d * cap, dtype=I32)
+        is_x = r_op == Op.ACQ_X_READ
+        is_s = r_op == Op.ACQ_S_READ
+        rows = jnp.where(r_op != 0, r_row, sent)
+        with waves.scope("multihost_sb", "arbitrate"):
+            first_x = jnp.full((m1,), BIG, I32).at[
+                jnp.where(is_x, rows, oob)].min(lanes, mode="drop")
+            first_s = jnp.full((m1,), BIG, I32).at[
+                jnp.where(is_s, rows, oob)].min(lanes, mode="drop")
+            held_x = state.x_step[rows] == t - 1
+            held_s = state.s_step[rows] == t - 1
+            slot_free = ~held_x & ~held_s
+            x_wins = (first_x[rows] < first_s[rows]) & slot_free
+            grant_x = is_x & x_wins & (first_x[rows] == lanes)
+            grant_s = is_s & ~held_x & ~x_wins
+            s_writer = grant_s & (first_s[rows] == lanes)
+            x_step = state.x_step.at[jnp.where(grant_x, rows, oob)].set(
+                t, mode="drop", unique_indices=True)
+            s_step = state.s_step.at[
+                jnp.where(s_writer, rows, oob)].set(
+                t, mode="drop", unique_indices=True)
+            raw_bal = state.bal[rows]
+            g_bal = jnp.where(grant_x | grant_s, raw_bal.astype(I32), 0)
+
+        # ---- replies back to sources + classify -----------------------
+        with waves.scope("multihost_sb", "reply"):
+            rep_g = _exchange(grant_x | grant_s)
+            rep_b = _exchange(g_bal)
+            back = jnp.where(valid, dest * cap + pos, 0)
+            granted = (jnp.where(valid, rep_g[back], False)
+                       .reshape(w, L))
+            bal = jnp.where(granted, rep_b[back].reshape(w, L), 0)
+            lock_rejected = ((l_op != 0) & ~granted).any(axis=1)
+            alive = ~lock_rejected & (l_op[:, 0] != 0)
+
+            nw, do, logic_abort, commit, committed = compute_phase(
+                ttype, bal, alive, ts_amt)
+            do_write = do & commit[:, None] & (l_op != 0)
+            bal_delta = jnp.sum(jnp.where(do_write, nw - bal, 0),
+                                dtype=I32)
+
+        new_ctx = SBCtx(
+            acc=l_ac, tbl=l_tb, do_write=do_write, nw=nw,
+            attempted=jnp.asarray(w if gen_new else 0, I32),
+            committed=committed.sum(dtype=I32),
+            ab_lock=(lock_rejected & (l_op[:, 0] != 0)).sum(dtype=I32),
+            ab_logic=logic_abort.sum(dtype=I32),
+            magic_bad=jnp.asarray(0, I32),
+            bal_delta=bal_delta,
+            overflow=(active & ~valid).sum(dtype=I32))
+
+        # ---- wave 2 of c1: route installs to owners -------------------
+        with waves.scope("multihost_sb", "install_route"):
+            wmask = c1.do_write.reshape(-1)
+            wdest = (c1.acc.reshape(-1) % d).astype(I32)
+            wrow = (c1.tbl.reshape(-1) * n_loc
+                    + c1.acc.reshape(-1) // d).astype(I32)
+            wpos = _positions(wdest, wmask, d)
+            wvalid = wmask & (wpos < cap)   # no overflow: writes <= locks
+            i_m, i_row, i_bal, i_tbl, i_acc = _route(
+                wdest, wpos, wvalid, cap, d,
+                [wmask.astype(I32), wrow, c1.nw.reshape(-1),
+                 c1.tbl.reshape(-1), c1.acc.reshape(-1)])
+            inst = [_exchange(x)
+                    for x in (i_m, i_row, i_bal, i_tbl, i_acc)]
+            i_m, i_row, i_bal, i_tbl, i_acc = inst
+            i_mask = i_m != 0
+
+            irows = jnp.where(i_mask, i_row, oob)
+            bal_new = state.bal.at[irows].set(i_bal.astype(U32),
+                                              mode="drop",
+                                              unique_indices=True)
+            newval = jnp.zeros((d * cap, VW), U32).at[:, 0].set(
+                i_bal.astype(U32))
+            log = logring.append_rep(state.log, i_mask, i_tbl,
+                                     jnp.zeros_like(i_bal),
+                                     jnp.zeros_like(i_bal, U32),
+                                     i_acc.astype(U32),
+                                     jnp.broadcast_to(t, i_mask.shape),
+                                     newval)
+
+        def mk_entry(mask, row, balv, tblv, accv, ring, bck, slot,
+                     src_dev):
+            # forwarded entries tag key_hi = SOURCE shard + 1 (own entries
+            # log 0 above), so recovery can verify a ring's streams
+            # against acct % D geometry — same convention as the 1-D
+            # runner; the source here is host h-off at the SAME chip
+            rr = jnp.where(mask, slot * m1 + row, N_BCK * m1)
+            bck = bck.at[rr].set(balv.astype(U32), mode="drop",
+                                 unique_indices=True)
+            nv = jnp.zeros((mask.shape[0], VW), U32)
+            nv = nv.at[:, 0].set(balv.astype(U32))
+            stepv = jnp.broadcast_to(t, mask.shape)
+            src = jnp.broadcast_to(src_dev.astype(U32) + U32(1),
+                                   mask.shape)
+            ring = logring.append_rep(ring, mask, tblv,
+                                      jnp.zeros_like(balv),
+                                      src, accv.astype(U32), stepv, nv)
+            return ring, bck
+
+        # CommitBck x2 + CommitLog at the backups: forward applied
+        # installs to hosts h+1, h+2 at the SAME chip coordinate — the 3
+        # replicas of every row live on 3 different hosts
+        with waves.scope("multihost_sb", "replicate"):
+            bck = state.bck_bal
+            for off in (1, 2):
+                perm = [(i, (i + off) % n_hosts) for i in range(n_hosts)]
+                pp = functools.partial(jax.lax.ppermute,
+                                       axis_name=DCN_AXIS, perm=perm)
+                fwd_mask = pp(i_mask)
+                if cnt is not None:
+                    hop = (mon.CTR_REPL_PUSH_HOP1 if off == 1
+                           else mon.CTR_REPL_PUSH_HOP2)
+                    cnt = mon.bump(cnt, {hop: fwd_mask.sum(dtype=I32)})
+                src_dev = ((h - off) % n_hosts) * n_ici + c
+                log, bck = mk_entry(fwd_mask, pp(i_row), pp(i_bal),
+                                    pp(i_tbl), pp(i_acc), log, bck,
+                                    off - 1, src_dev)
+
+        state = state.replace(bal=bal_new, bck_bal=bck, x_step=x_step,
+                              s_step=s_step, step=t + 1, log=log)
+
+        if cnt is not None:
+            # txn outcomes + overflow at the SOURCE, lock arbitration +
+            # installs at the OWNER (dsb convention), PLUS the per-axis
+            # route split counted at the source: a valid lane whose owner
+            # host == h crosses only ICI, otherwise it pays the DCN hop.
+            # Summed over devices: route_ici + route_dcn ==
+            # lock_requests + install_writes.
+            req = r_op != 0
+            grant = grant_x | grant_s
+            rej = req & ~grant
+            held = held_x | held_s
+            ici_lanes = ((valid & (dest // n_ici == h)).sum(dtype=I32)
+                         + (wvalid & (wdest // n_ici == h))
+                         .sum(dtype=I32))
+            dcn_lanes = ((valid & (dest // n_ici != h)).sum(dtype=I32)
+                         + (wvalid & (wdest // n_ici != h))
+                         .sum(dtype=I32))
+            cnt = mon.bump(cnt, {
+                mon.CTR_STEPS: 1,
+                mon.CTR_TXN_ATTEMPTED: c1.attempted,
+                mon.CTR_TXN_COMMITTED: c1.committed,
+                mon.CTR_AB_LOCK: c1.ab_lock,
+                mon.CTR_AB_LOGIC: c1.ab_logic,
+                mon.CTR_MAGIC_BAD: c1.magic_bad,
+                mon.CTR_ROUTE_OVERFLOW: c1.overflow,
+                mon.CTR_LOCK_REQUESTS: req.sum(dtype=I32),
+                mon.CTR_LOCK_GRANTED: grant.sum(dtype=I32),
+                mon.CTR_LOCK_REJECTED: rej.sum(dtype=I32),
+                mon.CTR_LOCK_REJECT_HELD: (rej & held).sum(dtype=I32),
+                mon.CTR_LOCK_REJECT_ARB: (rej & ~held).sum(dtype=I32),
+                mon.CTR_INSTALL_WRITES: i_mask.sum(dtype=I32),
+                mon.CTR_LOG_APPENDS: i_mask.sum(dtype=I32),
+                mon.CTR_ROUTE_ICI_LANES: ici_lanes,
+                mon.CTR_ROUTE_DCN_LANES: dcn_lanes,
+                mon.CTR_DISPATCH_XLA: 1,
+            })
+            cnt = mon.gauge_max(cnt, {mon.CTR_RING_HWM: log.head.max()})
+
+        new_ctx = jax.tree.map(
+            lambda x: pcast_varying(x, DCN_AXIS, ICI_AXIS), new_ctx)
+        stats = jax.lax.psum(
+            jax.lax.psum(_stats_of(c1), ICI_AXIS), DCN_AXIS)
+        return state, new_ctx, stats, cnt
+
+    def scan_fn(carry, key, gen_new=True):
+        state, c1 = carry[:2]
+        cnt = carry[2] if monitor else None
+        state, new_ctx, stats, cnt = local_step(state, c1, key, cnt,
+                                                gen_new)
+        out = (state, new_ctx) + ((cnt,) if monitor else ())
+        return out, stats
+
+    def sq(tree):
+        return jax.tree.map(lambda x: x[0, 0], tree)
+
+    def unsq(tree):
+        return jax.tree.map(lambda x: x[None, None], tree)
+
+    def block_local(*args):
+        key = args[-1]
+        keys = jax.random.split(key, cohorts_per_block)
+        carry, stats = jax.lax.scan(
+            scan_fn, tuple(sq(a) for a in args[:-1]), keys)
+        return tuple(unsq(x) for x in carry) + (stats,)
+
+    def drain_local(*args):
+        key = args[-1]
+        carry, s1 = scan_fn(tuple(sq(a) for a in args[:-1]), key,
+                            gen_new=False)
+        out = (unsq(carry[0]),) + ((unsq(carry[2]),) if monitor else ())
+        return out + (jnp.stack([s1]),)
+
+    grid = P(DCN_AXIS, ICI_AXIS)
+    n_carry = 3 if monitor else 2
+    spec = (grid,) * n_carry + (P(),)
+    block = jax.shard_map(block_local, mesh=mesh, in_specs=spec,
+                          out_specs=(grid,) * n_carry + (P(),))
+    drain_m = jax.shard_map(
+        drain_local, mesh=mesh, in_specs=spec,
+        out_specs=(grid,) * (2 if monitor else 1) + (P(),))
+    donate = tuple(range(n_carry))
+    jit_block = jax.jit(block, donate_argnums=donate)
+    jit_drain = jax.jit(drain_m, donate_argnums=donate)
+
+    def stack_leaf(one):
+        shard = NamedSharding(mesh, grid)
+        return jax.tree.map(
+            lambda x: jax.device_put(
+                jnp.broadcast_to(x[None, None],
+                                 (n_hosts, n_ici) + x.shape), shard),
+            one)
+
+    def run(carry, key):
+        out = jit_block(*carry, key)
+        return out[:-1], out[-1]
+
+    def init(state):
+        base = (state, stack_leaf(_empty_sb_ctx(w)))
+        return base + ((stack_leaf(mon.create()),) if monitor else ())
+
+    def drain(carry):
+        out = jit_drain(*carry, jax.random.PRNGKey(0))
+        if monitor:
+            return out[0], out[2], out[1]
+        return out
+
+    return run, init, drain

@@ -275,6 +275,26 @@ def _sb_extras(total):
     }
 
 
+def _mh_sb_runner(n_acc, w, cpb, hierarchical):
+    from dint_tpu.parallel import multihost as mhost
+    from dint_tpu.parallel import multihost_sb as mh
+
+    n_hosts, n_ici = mhost.mesh_shape_from_env()
+    mesh = mh.make_mesh_2d(n_hosts, n_ici)
+    run, init, drain = mh.build_multihost_sb_runner(
+        mesh, n_acc, w=w, cohorts_per_block=cpb,
+        hierarchical=hierarchical, monitor=_monitor_on())
+    return run, init(mh.create_multihost_sb(mesh, n_acc)), drain
+
+
+def _mh_sb_extras(total):
+    from dint_tpu.parallel import dense_sharded_sb as dsb
+
+    att, com, extra = _sb_extras(total)
+    extra["route_overflow"] = int(total[dsb.STAT_OVERFLOW])
+    return att, com, extra
+
+
 def run_point(results, name, fn, attempts=2, backoff_s=30):
     """Run one sweep point with per-point fault tolerance: the axon tunnel
     can drop mid-sweep (observed: remote_compile connection refused 75 min
@@ -968,6 +988,39 @@ def run_all(out: str, window_s: float = 10.0, quick: bool = False,
                        window_s=window_s, open_rates=rates, results=results,
                        lat_widths=lat_widths, point_extra=skew_extra,
                        geom={"l": sd.L, "vw": sd.VW})
+
+    if want("multihost_sb") and not skew_preset:
+        # hierarchical-vs-flat transport A/B over the 2-D (dcn x ici)
+        # mesh (parallel/multihost_sb.py): same global geometry, bit-
+        # identical outputs, only the collective decomposition differs —
+        # PERF.md round 14's "virtual-mesh bench no slower" leg of the
+        # hierarchical decision rule. DINT_BENCH_MESH picks the shape.
+        import jax
+
+        from dint_tpu.engines import smallbank_pipeline as sp
+        from dint_tpu.parallel import dense_sharded_sb as dsb
+        from dint_tpu.parallel import multihost as mhost
+
+        n_hosts, n_ici = mhost.mesh_shape_from_env()
+        if len(jax.devices()) < n_hosts * n_ici or n_hosts < 3:
+            print(f"multihost_sb: skipped ({n_hosts}x{n_ici} mesh needs "
+                  f"{n_hosts * n_ici} devices and >= 3 hosts; have "
+                  f"{len(jax.devices())} devices)", flush=True)
+        else:
+            mesh_extra = {
+                "n_shards": n_hosts * n_ici,
+                "mesh": {"n_hosts": n_hosts, "n_ici": n_ici,
+                         "axes": [mhost.DCN_AXIS, mhost.ICI_AXIS]}}
+            for tag, hier in (("hier", True), ("flat", False)):
+                sweep_pipeline(
+                    f"multihost_sb_{tag}",
+                    lambda w, b, h=hier: _mh_sb_runner(n_acc, w, b, h),
+                    _mh_sb_extras, dsb.N_STATS, widths=[256] if quick
+                    else [8192], cpb=cpb, depth=2,
+                    magic_idx=sp.STAT_MAGIC_BAD, window_s=window_s,
+                    open_rates=(), results=results,
+                    point_extra=dict(mesh_extra, hierarchical=hier),
+                    geom={"l": 3, "vw": 2, "d": n_hosts * n_ici})
 
     if skew_preset:
         # skew-sweep preset (--only smallbank_skew): one width, hot_frac

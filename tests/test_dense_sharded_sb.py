@@ -114,3 +114,44 @@ def test_lost_device_balance_range_recovers_from_any_ring():
         recovery.recover_sb_shard(
             n_accounts, 1, D, entries[1].reshape(lanes, cap, -1),
             heads[1], ring_owner=wrong)
+
+
+def test_route_overflow_fires_and_reconciles_with_monitor():
+    """Adversarial routing: every txn hits ONE hot account, so every
+    device aims all w*L lanes at a single destination bucket of capacity
+    2*ceil(w*L/D) — overflow MUST fire. Overflowed lanes degrade to lock
+    rejects (accounting still closes) and the psummed STAT_OVERFLOW
+    total reconciles EXACTLY with dintmon's route_overflow counter."""
+    from dint_tpu.monitor import counters as mon
+
+    mesh = dsb.make_mesh(D)
+    state = dsb.create_sharded_sb(mesh, D, 4096)
+    base = dsb.total_balance_global(state)
+    run, init, drain = dsb.build_sharded_sb_runner(
+        mesh, D, 4096, w=64, cohorts_per_block=2,
+        hot_frac=1.0 / 4096, hot_prob=1.0, monitor=True)
+    carry = init(state)
+    key = jax.random.PRNGKey(3)
+    total = np.zeros(dsb.N_STATS, np.int64)
+    for i in range(3):
+        carry, stats = run(carry, jax.random.fold_in(key, i))
+        total += np.asarray(stats, np.int64).sum(axis=0)
+    state, tail, cnt = drain(carry)
+    total += np.asarray(tail, np.int64).sum(axis=0)
+
+    overflow = int(total[dsb.STAT_OVERFLOW])
+    assert overflow > 0
+    # dropped lanes surface as lock aborts, never as lost txns
+    attempted = int(total[dsb.STAT_ATTEMPTED])
+    assert attempted == 3 * 2 * 64 * D
+    assert int(total[dsb.STAT_COMMITTED]) + int(total[dsb.STAT_AB_LOCK]) \
+        + int(total[dsb.STAT_AB_LOGIC]) == attempted
+    # and conservation survives the drops
+    final = dsb.total_balance_global(state)
+    assert (final - base) % (1 << 32) == \
+        int(total[dsb.STAT_BAL_DELTA]) % (1 << 32)
+    # exact reconciliation: the stats plane and the counter plane count
+    # the same event at the same site (source device, cohort completion)
+    snap = mon.snapshot(cnt)
+    assert snap["route_overflow"] == overflow
+    assert snap["txn_attempted"] == attempted

@@ -368,3 +368,41 @@ def test_cli_unknown_target_exits_2(capsys):
     assert exc.value.code == 2
     err = capsys.readouterr().err
     assert "unknown" in err and "tatp_dense/block" in err
+
+
+# ------------------------------------------- hierarchical route (2-D mesh)
+
+
+def test_hier_route_strictly_fewer_dcn_bytes_everywhere():
+    """Round-14 tentpole, statically: at EVERY calibrated 2-D geometry
+    the hierarchical (ici-then-dcn) route moves strictly fewer bytes
+    over the dcn axis than its flat tuple-axis twin — the whole reason
+    the transport restructure exists. 1-D targets carry no dcn bytes at
+    all (the axis split only prices the 2-D mesh)."""
+    pairs = 0
+    for name, twin in sorted(T.TARGET_FLAT_TWIN.items()):
+        mh_, mf_ = cost.model_for(name), cost.model_for(twin)
+        assert not mh_.error and not mf_.error, (name, mh_.error)
+        assert mh_.dcn_bytes_per_step < mf_.dcn_bytes_per_step, \
+            (name, mh_.dcn_bytes_per_step, twin, mf_.dcn_bytes_per_step)
+        assert mh_.dcn_bytes_per_step > 0
+        assert mh_.axis_bytes_per_step()["ici"] > 0
+        pairs += 1
+    assert pairs >= 3         # block, block@mon, block@h3
+    assert cost.model_for("dense_sharded_sb/block").dcn_bytes_per_step == 0
+
+
+def test_hier_dominance_finding_fires_when_hier_regresses(monkeypatch):
+    """Liveness for the hier-dcn-dominance gate: point a target at
+    itself as its own flat twin — equal dcn bytes is NOT strict
+    dominance, so the error must fire and name the twin."""
+    from types import SimpleNamespace
+
+    from dint_tpu.analysis.passes import cost_budget as cb
+
+    name = "multihost_sb/block@flat"
+    model = cost.model_for(name)
+    monkeypatch.setitem(T.TARGET_FLAT_TWIN, name, name)
+    fs = cb._hier_dominance_findings(SimpleNamespace(name=name), model)
+    assert [f.code for f in fs] == ["hier-dcn-dominance"]
+    assert fs[0].severity == "error" and fs[0].site == name

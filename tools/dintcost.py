@@ -56,7 +56,9 @@ DEFAULT_ALLOWLIST = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 # bumped when keys of the --json payload change shape; bench artifacts
 # embed the report payload and the hw_round scripts archive it
-JSON_SCHEMA = 1
+# schema 2: per-axis link bytes (ici_bytes_per_step / dcn_bytes_per_step
+# at top level and per wave) for the 2-D mesh targets
+JSON_SCHEMA = 2
 
 DEFAULT_BYTES_PCT = 10.0
 
@@ -141,9 +143,16 @@ def cmd_report(args, ap) -> int:
               f"(inputs {e['input_bytes']}, donated {e['donated_bytes']})"
               + (f"  (budget {bud['footprint']})"
                  if bud["footprint"] is not None else ""))
+        if e.get("ici_bytes_per_step") or e.get("dcn_bytes_per_step"):
+            print(f"  link bytes/step ici {e['ici_bytes_per_step']:g}  "
+                  f"dcn {e['dcn_bytes_per_step']:g}")
         for w, r in e["waves"].items():
+            link = ""
+            if r.get("ici_bytes_per_step") or r.get("dcn_bytes_per_step"):
+                link = (f"  (ici {r['ici_bytes_per_step']:g} / "
+                        f"dcn {r['dcn_bytes_per_step']:g})")
             print(f"    {w:44s} {r['bytes_per_step']:>10g} B "
-                  f"{r['dispatches_per_step']:>6g} disp")
+                  f"{r['dispatches_per_step']:>6g} disp{link}")
         for c in e["reconcile"]:
             mark = "ok " if c["ok"] else "FAIL"
             exp = f" expect={c['expect']}" if c["expect"] else ""
