@@ -381,6 +381,10 @@ def _child_main():
     out["dintcost"] = cost
     if cost_err:
         out["dintcost_error"] = cost_err
+    dur, dur_err = _dintdur_snapshot()
+    out["dintdur"] = dur
+    if dur_err:
+        out["dintdur_error"] = dur_err
     if os.environ.get("DINT_BENCH_SKIP_SB") == "1":
         # short-budget retry child (see TOTAL_BUDGET_S): the parent asked
         # us to skip the secondary leg rather than lose it to the timeout
@@ -445,6 +449,35 @@ def _dintcost_snapshot():
             return None, (f"dintcost rc={c.returncode}, no JSON line; "
                           f"stderr tail: {c.stderr.strip()[-200:]}")
         return json.loads(lines[-1]), None
+    except Exception as e:  # noqa: BLE001 — never kills the bench
+        return None, repr(e)[:200]
+
+
+def _dintdur_snapshot():
+    """`dintdur check --all --json` in a CPU subprocess so every perf
+    artifact records the durability-gate verdict it ran under
+    (ANALYSIS.md "Durability facts & passes") — throughput measured on
+    an engine whose write-ahead/quorum/replay proofs were red is not a
+    durable-transaction number. Same contract as _dintlint_snapshot:
+    never voids the measurement (DINT_BENCH_LINT=0 disables all gates)."""
+    if os.environ.get("DINT_BENCH_LINT", "1") == "0":
+        return None, "disabled (DINT_BENCH_LINT=0)"
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "dintdur.py")
+    timeout = float(os.environ.get("DINT_BENCH_LINT_TIMEOUT", "420"))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        c = subprocess.run([sys.executable, tool, "check", "--all",
+                            "--json"],
+                           capture_output=True, text=True, env=env,
+                           timeout=timeout)
+        lines = [ln for ln in c.stdout.splitlines() if ln.startswith("{")]
+        if not lines:
+            return None, (f"dintdur rc={c.returncode}, no JSON line; "
+                          f"stderr tail: {c.stderr.strip()[-200:]}")
+        payload = json.loads(lines[-1])
+        payload.pop("findings", None)   # reproducible from the tree
+        return payload, None
     except Exception as e:  # noqa: BLE001 — never kills the bench
         return None, repr(e)[:200]
 

@@ -25,7 +25,7 @@ import time as _time
 from . import passes as _passes          # noqa: F401 — registers the passes
 from . import allowlist as _allowlist
 from .core import (Finding, PASS_DOCS, PASSES, SEV_ERROR, SEV_INFO,  # noqa: F401
-                   SEV_WARNING, TargetTrace, trace_target)
+                   SEV_WARNING, TargetTrace, to_sarif, trace_target)
 from .targets import (TARGET_DOCS, TARGET_PROTOCOL, TARGETS,  # noqa: F401
                       TRACE_CACHE, SkipTarget, get_trace)
 

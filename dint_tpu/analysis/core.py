@@ -37,6 +37,8 @@ from typing import Callable, Iterator
 
 import jax
 import jax._src.core as jcore
+from jax._src import linear_util as _lu
+from jax._src import pjit as _pjit
 from jax._src import source_info_util
 
 SEV_ERROR = "error"
@@ -115,7 +117,21 @@ def trace_target(name: str, fn: Callable, args, *, mesh_axes=(),
     """Trace `fn(*args)` to a jaxpr with abstract values; a trace failure
     (concretization, host sync, data-dependent Python branching) is
     captured as `trace_error` for the purity pass instead of raised."""
+    # jit-wrapped ufuncs (jnp.mod, jnp.remainder, ...) stage through
+    # pjit's memoized_fun, which caches the inner jaxpr BY AVALS and
+    # keeps the source_info of the FIRST caller.  If an engine ran (or
+    # another target traced) earlier in this process, our eqns inherit
+    # that caller's file:line and every site_of-keyed fact (LOG_SLOT,
+    # TRUNCATED, ...) mis-seeds.  Clearing the lu staging caches and
+    # pjit's param cache before each target trace makes provenance
+    # order-independent; re-staging is milliseconds, and — unlike
+    # jax.clear_caches() — the compiled C++ executable caches survive,
+    # so engines running later in the same process (the test suite) do
+    # not recompile.
     try:
+        for clear in list(_lu.cache_clearing_funs):
+            clear()
+        _pjit._infer_params_cached.cache_clear()
         closed = jax.make_jaxpr(fn)(*args)
     except Exception as e:          # noqa: BLE001 — any trace failure is data
         return TargetTrace(name, None, trace_error=e,
@@ -305,6 +321,64 @@ def used_after(jaxpr: jcore.Jaxpr, var, after: int) -> str:
         if ov is var:
             return "escapes as a jaxpr output"
     return ""
+
+
+# ------------------------------------------------------------ SARIF export
+
+# Minimal SARIF 2.1.0 (the schema slice documented in ANALYSIS.md): one
+# run, one rule per pass/code pair, one result per finding; allowlisted
+# findings ride along as suppressions so SARIF viewers grey them out
+# instead of dropping them.
+_SARIF_LEVEL = {SEV_ERROR: "error", SEV_WARNING: "warning", SEV_INFO: "note"}
+
+
+def to_sarif(findings: list[Finding], tool_name: str) -> dict:
+    """Serialize findings as a SARIF 2.1.0 log (shared by the dintlint
+    and dintdur CLIs' --sarif flags)."""
+    rules: dict[str, dict] = {}
+    results = []
+    for f in findings:
+        rule_id = f"{f.pass_name}/{f.code}"
+        rules.setdefault(rule_id, {
+            "id": rule_id,
+            "shortDescription": {"text": PASS_DOCS.get(f.pass_name,
+                                                       f.pass_name)},
+        })
+        result = {
+            "ruleId": rule_id,
+            "level": _SARIF_LEVEL.get(f.severity, "none"),
+            "message": {"text": f.message + (
+                f"\nfix: {f.suggestion}" if f.suggestion else "")},
+            "properties": {"target": f.target, "primitive": f.primitive,
+                           "path": f.path, "count": f.count},
+        }
+        if f.site:
+            uri, _, line = f.site.rpartition(":")
+            region = {}
+            if line.isdigit():
+                region["startLine"] = int(line)
+            else:
+                uri = f.site
+            loc = {"physicalLocation": {
+                "artifactLocation": {"uri": uri or f.site}}}
+            if region:
+                loc["physicalLocation"]["region"] = region
+            result["locations"] = [loc]
+        if f.suppressed:
+            result["suppressions"] = [{"kind": "external",
+                                       "justification": f.allowed_by}]
+        results.append(result)
+    return {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {"name": tool_name,
+                                "rules": sorted(rules.values(),
+                                                key=lambda r: r["id"])}},
+            "results": results,
+        }],
+    }
 
 
 # ---------------------------------------------------------- pass registry

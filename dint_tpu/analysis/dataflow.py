@@ -59,6 +59,28 @@ Facts (a small powerset lattice, may-analysis: a fact on a value means
     REPL_PUSHED crossed an ICI replication hop. Seeded at every
                 `ppermute` output (the CommitBck/CommitLog fan-out).
 
+  durability facts (dintdur, passes/durability.py; ANALYSIS.md
+  "Durability facts & passes"):
+    LOG_SLOT    (provenance) a ring slot id computed by the log-append
+                machinery. Seeded at `rem` eqns whose source site lies in
+                tables/log.py — the `pos % capacity` of `append`/
+                `plan_rep` — so any scatter whose INDICES carry LOG_SLOT
+                is a log append, on the XLA route (append/append_rep),
+                the forwarded-backup route (_apply_backup), and the fused
+                route (plan_rep's `flat` rides into scatter_streams).
+    LOGGED      (protocol) written by a log-append scatter: seeded at
+                scatter eqns whose index operand carries LOG_SLOT. The
+                wal-order check pairs these appends against the
+                commit-visible installs by their shared lane-mask facts.
+    TRUNCATED   (protocol) a ring watermark advance: seeded at the `min`
+                clamp of tables/log.advance_watermark. A durable target
+                whose trace appends but never reaches a TRUNCATED seed
+                has an unbounded ring (the ROADMAP log-truncation item).
+  DURABLE is derived, not propagated: a LOGGED root is durable once the
+  recorded ppermute perms prove >= 2 distinct non-self destinations per
+  source (Dataflow.quorum_dests / durable_roots below) — the replica-
+  quorum placement the quorum-fanout check enforces.
+
 Why two phases: seed conditions like "TBL_READ without ARB" are not
 monotone, so running them during the carry fixpoint would let an
 under-resolved round-1 fact (the arb array before its scatter-max loops
@@ -94,10 +116,18 @@ STATE = "STATE"
 TBL_READ = "TBL_READ"
 ARB = "ARB"
 SORTED = "SORTED"
+LOG_SLOT = "LOG_SLOT"
+LOGGED = "LOGGED"
+TRUNCATED = "TRUNCATED"
 
-PROTOCOL_FACTS = (LOCK_WIN, VALIDATED, STAMP, ABORT_MASK, REPL_PUSHED)
-PROVENANCE_FACTS = (STATE, TBL_READ, ARB, SORTED)
+PROTOCOL_FACTS = (LOCK_WIN, VALIDATED, STAMP, ABORT_MASK, REPL_PUSHED,
+                  LOGGED, TRUNCATED)
+PROVENANCE_FACTS = (STATE, TBL_READ, ARB, SORTED, LOG_SLOT)
 ALL_FACTS = PROTOCOL_FACTS + PROVENANCE_FACTS
+
+# source anchor for the durability seeds: the slot math of append/plan_rep
+# and the watermark clamp of advance_watermark both live here
+_LOG_MODULE = "tables/log.py"
 
 _SCATTER_ARB = frozenset({"scatter-max", "scatter-min"})
 _SCATTER_FAMILY = frozenset({"scatter", "scatter-add", "scatter-mul",
@@ -139,6 +169,12 @@ class ScatterRec:
     its first non-derived var (a jaxpr input / constvar). Scatters in
     the same jaxpr sharing a root write the same state array — how the
     protocol pass groups a lock table's acquire and release sites.
+
+    ``idx_rows``/``trips`` size the write statically for the dintdur
+    ring-bound check: idx_rows is the index batch width (masked lanes
+    included — an upper bound on rows written per dispatch) and trips the
+    product of enclosing scan lengths, so idx_rows * trips bounds the
+    rows this site writes per trace.
     """
     prim: str
     site: str
@@ -150,10 +186,27 @@ class ScatterRec:
     update_facts: frozenset
     root: object                   # Var | None (None = fresh array)
     idx_nonconst: bool             # indices are a traced (non-const) value
+    idx_rows: int = 0              # index batch width (0 = unknown)
+    trips: float = 1.0             # product of enclosing scan lengths
+    fused: bool = False            # synthetic scatter_streams record
 
     @property
     def write_facts(self) -> frozenset:
         return self.index_facts | self.update_facts
+
+
+@dataclasses.dataclass
+class PermRec:
+    """One `ppermute` with its static permutation (perms are Python tuples
+    in the eqn params, so quorum placement is statically evaluable)."""
+    perm: tuple                    # ((src, dst), ...)
+    axis: str                      # axis_name, "" if undeclared
+    site: str
+    path: tuple[str, ...]
+
+    @property
+    def identity(self) -> bool:
+        return all(int(s) == int(d) for s, d in self.perm)
 
 
 @dataclasses.dataclass
@@ -163,9 +216,39 @@ class Dataflow:
     scatters: list[ScatterRec]
     ppermutes: list[SeedSite]          # fact == REPL_PUSHED sites
     pallas_locks: list[SeedSite]       # detected lock_arbitrate calls
+    perms: list[PermRec] = dataclasses.field(default_factory=list)
 
     def seeded(self, fact: str) -> list[SeedSite]:
         return [s for s in self.seeds if s.fact == fact]
+
+    def log_appends(self) -> list[ScatterRec]:
+        """Scatters whose indices descend from the log slot math — the
+        LOGGED sites, fused and unfused routes alike."""
+        return [r for r in self.scatters if LOG_SLOT in r.index_facts]
+
+    def quorum_dests(self) -> dict[int, set[int]]:
+        """Per-source destination sets, unioned over every recorded
+        non-identity perm (self-sends excluded): the static replica
+        placement of the CommitBck/CommitLog fan-out."""
+        dests: dict[int, set[int]] = {}
+        for rec in self.perms:
+            if rec.identity:
+                continue
+            for s, d in rec.perm:
+                dests.setdefault(int(s), set())
+                if int(d) != int(s):
+                    dests[int(s)].add(int(d))
+        return dests
+
+    def durable_roots(self) -> set[int]:
+        """ids of LOGGED roots that are DURABLE: the trace both appends to
+        them and pushes >= 2 distinct-destination replication hops, so a
+        single fault domain cannot hold every copy."""
+        dests = self.quorum_dests()
+        if not dests or min(len(v) for v in dests.values()) < 2:
+            return set()
+        return {id(r.root) for r in self.log_appends()
+                if r.root is not None}
 
 
 # --------------------------------------------------------------- analyzer
@@ -200,7 +283,9 @@ class _Analyzer:
         self._seeds: dict = {}              # (fact, id(eqn)) -> SeedSite
         self._scatters: dict = {}           # id(eqn) -> ScatterRec
         self._ppermutes: dict = {}
+        self._perms: dict = {}              # id(eqn) -> PermRec
         self._pallas: dict = {}
+        self._mult = 1.0                    # product of enclosing scan trips
 
     # -- env helpers ------------------------------------------------------
 
@@ -251,7 +336,8 @@ class _Analyzer:
             seeds=list(self._seeds.values()),
             scatters=list(self._scatters.values()),
             ppermutes=list(self._ppermutes.values()),
-            pallas_locks=list(self._pallas.values()))
+            pallas_locks=list(self._pallas.values()),
+            perms=list(self._perms.values()))
 
     def _phase(self, jaxpr, protocol: bool, top_facts):
         self.protocol_phase = protocol
@@ -350,7 +436,14 @@ class _Analyzer:
             self.flow(body, path + ("scan",), in_pallas)
             return [self.facts(v) for v in body.outvars]
 
-        outs = self._fixpoint(one_pass, carry)
+        # scatters recorded inside the body write once per trip: scale
+        # their static row bound by the scan length (dintdur ring bound)
+        mult = self._mult
+        try:
+            self._mult = mult * float(eqn.params.get("length", 1) or 1)
+            outs = self._fixpoint(one_pass, carry)
+        finally:
+            self._mult = mult
         for ov, fs in zip(eqn.outvars, outs):
             self.bind(ov, fs)
 
@@ -488,6 +581,7 @@ class _Analyzer:
             return
         for s in range(s_n):
             idx, vals, tab = ins[s], ins[s_n + s], ins[2 * s_n + s]
+            shp = getattr(idx.aval, "shape", ())
             self._scatters[(id(eqn), s)] = ScatterRec(
                 prim="scatter", site=site_of(eqn), path=path,
                 in_pallas=False,
@@ -496,7 +590,9 @@ class _Analyzer:
                 index_facts=frozenset(self.allfacts(idx)),
                 update_facts=frozenset(self.allfacts(vals)),
                 root=self._operand_root(tab, defs),
-                idx_nonconst=not self.is_const(idx))
+                idx_nonconst=not self.is_const(idx),
+                idx_rows=int(shp[0]) if shp else 1, trips=self._mult,
+                fused=True)
 
     def _pallas_call(self, eqn, defs, path):
         name = self._kernel_name(eqn)
@@ -597,6 +693,13 @@ class _Analyzer:
             base.discard(STATE)
             if prim == "sort":
                 extra.add(SORTED)
+            elif prim == "rem":
+                # the slot math of tables/log.append / plan_rep: anything
+                # this feeds (the flat row ids, fused or unfused) is log-
+                # append indexing. Monotone (site test is constant), so
+                # safe inside the phase-1 fixpoint.
+                if _LOG_MODULE in site_of(eqn):
+                    extra.add(LOG_SLOT)
             elif prim in _GATHERS:
                 op_f = self.facts(ins[0])
                 if STATE in op_f:
@@ -641,6 +744,21 @@ class _Analyzer:
                 if self.recording:
                     self._ppermutes[id(eqn)] = SeedSite(
                         REPL_PUSHED, prim, site_of(eqn), path)
+                    perm = eqn.params.get("perm")
+                    if perm:
+                        ax = eqn.params.get("axis_name",
+                                            eqn.params.get("axes", ""))
+                        if isinstance(ax, (tuple, list)):
+                            ax = ",".join(str(a) for a in ax)
+                        self._perms[id(eqn)] = PermRec(
+                            perm=tuple((int(s), int(d)) for s, d in perm),
+                            axis=str(ax), site=site_of(eqn), path=path)
+            elif prim == "min":
+                # the watermark clamp of tables/log.advance_watermark —
+                # the only truncation anchor the rings expose
+                if _LOG_MODULE in site_of(eqn):
+                    extra.add(TRUNCATED)
+                    self._seed(TRUNCATED, eqn, path)
             elif prim == "shift_left":
                 op0 = ins[0]
                 if not self.is_const(op0) \
@@ -656,21 +774,31 @@ class _Analyzer:
                         and self._scalar_invar_rooted(op0, jaxpr, defs):
                     extra.add(STAMP)
                     self._seed(STAMP, eqn, path)
-            if prim in _SCATTER_FAMILY and self.recording:
+            if prim in _SCATTER_FAMILY:
                 idx = ins[1] if len(ins) > 1 else None
-                upd = ins[2] if len(ins) > 2 else None
-                self._scatters[id(eqn)] = ScatterRec(
-                    prim=prim, site=site_of(eqn), path=path,
-                    in_pallas=in_pallas,
-                    is_state=STATE in self.pfacts(ins[0]),
-                    operand_facts=frozenset(self.allfacts(ins[0])),
-                    index_facts=frozenset(self.allfacts(idx)
-                                          if idx is not None else ()),
-                    update_facts=frozenset(self.allfacts(upd)
-                                           if upd is not None else ()),
-                    root=self._operand_root(ins[0], defs),
-                    idx_nonconst=(idx is not None
-                                  and not self.is_const(idx)))
+                if prim == "scatter" and idx is not None \
+                        and LOG_SLOT in self.pfacts(idx):
+                    extra.add(LOGGED)
+                    self._seed(LOGGED, eqn, path)
+                if self.recording:
+                    upd = ins[2] if len(ins) > 2 else None
+                    rows = 0
+                    if idx is not None:
+                        shp = getattr(idx.aval, "shape", ())
+                        rows = int(shp[0]) if shp else 1
+                    self._scatters[id(eqn)] = ScatterRec(
+                        prim=prim, site=site_of(eqn), path=path,
+                        in_pallas=in_pallas,
+                        is_state=STATE in self.pfacts(ins[0]),
+                        operand_facts=frozenset(self.allfacts(ins[0])),
+                        index_facts=frozenset(self.allfacts(idx)
+                                              if idx is not None else ()),
+                        update_facts=frozenset(self.allfacts(upd)
+                                               if upd is not None else ()),
+                        root=self._operand_root(ins[0], defs),
+                        idx_nonconst=(idx is not None
+                                      and not self.is_const(idx)),
+                        idx_rows=rows, trips=self._mult)
 
         out = frozenset(base | extra)
         for ov in eqn.outvars:

@@ -16,9 +16,13 @@ walking machinery and ANALYSIS.md for the invariant catalogue):
                      the waves.py ledger, stay under the registered
                      budgets, and @fused dominates its unfused twin
                      (analysis/cost.py — the dintcost gate)
+  durability         log-before-visible, replica quorum on distinct
+                     fault domains, bounded rings, replay coverage,
+                     in-doubt totality (analysis/dataflow.py's LOGGED/
+                     TRUNCATED facts — the dintdur gate)
 
 Adding a pass: write `passes/<name>.py`, decorate the entry point with
 `@core.register_pass("<name>")`, import it here.
 """
-from . import (aliasing, cost_budget, protocol, purity,  # noqa: F401
-               scatter_race, shard_consistency, u64_overflow)
+from . import (aliasing, cost_budget, durability, protocol,  # noqa: F401
+               purity, scatter_race, shard_consistency, u64_overflow)

@@ -695,6 +695,99 @@ def _t_multihost() -> TargetTrace:
                         mesh_axes=(mhost.DCN_AXIS, mhost.ICI_AXIS))
 
 
+# ------------------------------------------------- durability (dintdur)
+# Every engine family that owns replicated log rings declares 'durable':
+# passes/durability.py then proves log-before-visible ordering, replica
+# quorum placement, and ring bounds on its trace. The generic pipelines
+# and sharded/* servers keep no local rings (the reference's log server
+# is a separate role there), so they stay un-flagged. The loop keeps the
+# flag in lockstep with future variants of the same families.
+
+_DURABLE_FAMILIES = ("tatp_dense/", "smallbank_dense/", "dense_sharded/",
+                     "dense_sharded_sb/", "multihost_sb/", "multihost/")
+
+for _name in list(TARGET_PROTOCOL):
+    if _name.startswith(_DURABLE_FAMILIES):
+        TARGET_PROTOCOL[_name] = TARGET_PROTOCOL[_name] + ("durable",)
+del _name
+
+
+# ---------------------------------------------- recovery replay targets
+# The traceable jnp twins of recovery.py's numpy paths (same winner-per-
+# row rule; recovery.py module docstring). Registered so dintdur's
+# replay-coverage check can statically compare what the engines install
+# against what replay reconstructs, and which log columns replay reads
+# against the entry layout the engines populate. The 'replay' flag gates
+# the replay-side checks in passes/durability.py.
+
+# engine target -> its replay twin: durability proves the twin's
+# entries-derived outputs cover every table class the engine installs
+REPLAY_TWINS: dict[str, str] = {
+    "tatp_dense/block": "recovery/tatp_dense",
+    "smallbank_dense/block": "recovery/smallbank_dense",
+}
+# entry-layout spec per replay target: `val_words` is the populated
+# value-word count (columns [HDR, HDR+val_words) of the ring; anything
+# past that is never written by the engines — the overread arm)
+REPLAY_SPECS: dict[str, dict] = {
+    "recovery/tatp_dense": dict(val_words=_VW),
+    "recovery/smallbank_dense": dict(val_words=2),
+    "recovery/sb_shard": dict(val_words=2),
+}
+
+
+def _ring_avals(lanes: int, capacity: int, val_words: int):
+    from ..tables.log import HDR_WORDS
+    return (jax.ShapeDtypeStruct((lanes, capacity,
+                                  HDR_WORDS + val_words), U32),
+            jax.ShapeDtypeStruct((lanes,), U32))
+
+
+@register_target("recovery/tatp_dense",
+                 "traceable replay twin of recovery.recover_tatp_dense: "
+                 "rebuild val+meta from one surviving replica ring",
+                 protocol=('replay',))
+def _t_recovery_tatp() -> TargetTrace:
+    from .. import recovery
+    from ..engines import tatp_dense as td
+    db0 = _abstract(lambda: td.create(_N_SUB, val_words=_VW,
+                                      log_capacity=_LOGCAP))
+    entries, heads = _ring_avals(db0.log.lanes, db0.log.capacity, _VW)
+    return trace_target("recovery/tatp_dense",
+                        recovery.replay_tatp_dense, (db0, entries, heads))
+
+
+@register_target("recovery/smallbank_dense",
+                 "traceable replay twin of recovery."
+                 "recover_smallbank_dense: balances + resumed step",
+                 protocol=('replay',))
+def _t_recovery_sb() -> TargetTrace:
+    from .. import recovery
+    from ..engines import smallbank_dense as sd
+    db0 = _abstract(lambda: sd.create(_N_ACCT, log_capacity=_LOGCAP))
+    entries, heads = _ring_avals(db0.log.lanes, db0.log.capacity, 2)
+    return trace_target("recovery/smallbank_dense",
+                        recovery.replay_smallbank_dense,
+                        (db0, entries, heads))
+
+
+@register_target("recovery/sb_shard",
+                 "traceable replay twin of recovery.recover_sb_shard: a "
+                 "dead device's primary balance range from any one ring",
+                 protocol=('replay',))
+def _t_recovery_sb_shard() -> TargetTrace:
+    import functools
+
+    from .. import recovery
+    from ..parallel.dense_sharded_sb import m1_local
+    bal0 = jax.ShapeDtypeStruct(
+        (m1_local(_N_ACCT * _MESH_SHARDS, _MESH_SHARDS),), U32)
+    entries, heads = _ring_avals(16, _LOGCAP, 2)
+    fn = functools.partial(recovery.replay_sb_shard, dead=1,
+                           n_shards=_MESH_SHARDS)
+    return trace_target("recovery/sb_shard", fn, (bal0, entries, heads))
+
+
 # -------------------------------------------------- static cost budgets
 #
 # The dintcost ledger (analysis/cost.py, gated by passes/cost_budget.py).
@@ -859,6 +952,16 @@ TARGET_COST.update({
     "multihost/block": _cost(dict(w=_W, k=4, vw=_VW, d=8, h=4), 33,
                              918424, bytes_budget=11000,
                              wave_expect=_MH_EXPECT),
+    # recovery replay twins (cold path, one invocation per fault — the
+    # budget exists so replay cannot silently grow a per-entry dispatch
+    # loop): no waves.py formulas, absolute bytes ceilings like the
+    # pipeline targets
+    "recovery/tatp_dense": _cost(dict(w=_W, k=4, vw=_VW), 2, 493848,
+                                 steps=1.0, bytes_budget=51200),
+    "recovery/smallbank_dense": _cost(dict(w=_W, l=3, vw=2), 1, 349392,
+                                      steps=1.0, bytes_budget=10240),
+    "recovery/sb_shard": _cost(dict(w=_W, l=3, vw=2, d=_MESH_SHARDS), 1,
+                               50248, steps=1.0, bytes_budget=10240),
 })
 
 

@@ -11,6 +11,18 @@ highest-versioned log entry per row IS the row's final state.
 
 Recovery is a host-side (numpy) path: it is not a hot loop, and the log
 rings fetch as plain arrays.
+
+The `replay_*` functions below are the traceable (jnp) twins of the
+numpy paths: same winner-per-row rule, expressed as scatter-max winner
+selection + one unique-index install scatter so `jax.make_jaxpr` sees
+them. They exist for dintdur's replay-coverage check
+(analysis/passes/durability.py): registered as analysis targets
+(`recovery/*` in analysis/targets.py), their traces prove statically
+that replay writes every table class the engines install and reads no
+log column the engines never populate. Column reads use BASIC slicing
+(`entries[:, :, 3]`, never fancy indexing) on purpose — each read then
+lowers to one `slice` eqn whose static (start, limit) the check compares
+against the entry layout.
 """
 from __future__ import annotations
 
@@ -151,6 +163,113 @@ def recover_sb_shard(n_accounts: int, dead: int, n_shards: int,
     bal[-1] = 0
     bal[urows] = vals[idx][:, 0]
     return bal
+
+
+def _replay_columns(entries, heads, val_words: int):
+    """Shared column extraction of the traceable twins: live-slot mask +
+    header words + value words of a [L, CAP, HDR+VW] ring, flattened to
+    [L*CAP] row streams. Basic slicing only (see module docstring)."""
+    import jax.numpy as jnp
+
+    _, cap, _ = entries.shape
+    flags = entries[:, :, 0].reshape(-1)
+    key_lo = entries[:, :, 2].reshape(-1)
+    ver = entries[:, :, 3].reshape(-1)
+    vals = entries[:, :, HDR_WORDS:HDR_WORDS + val_words].reshape(
+        -1, val_words)
+    slot = jnp.arange(cap, dtype=np.uint32)
+    live = (slot[None, :]
+            < jnp.minimum(heads, np.uint32(cap))[:, None]).reshape(-1)
+    return live, flags, key_lo, ver, vals
+
+
+def _replay_winners(rows, ver, live, n_rows: int):
+    """Max-version-per-row winner mask, the traceable `latest_per_row`:
+    scatter-max of ver+1 per row, then a second scatter-max of the flat
+    slot index breaks exact-version ties deterministically (the numpy
+    path's lexsort-last rule), so the final install is provably
+    one-writer (`unique_indices=True`)."""
+    import jax.numpy as jnp
+
+    I32, U32 = np.int32, np.uint32
+    safe = jnp.where(live, rows, n_rows)
+    best = jnp.zeros((n_rows + 1,), U32).at[safe].max(
+        ver + U32(1), mode="drop")
+    cand = live & (ver + U32(1) == best[safe])
+    fidx = jnp.arange(rows.shape[0], dtype=I32)
+    last = jnp.full((n_rows + 1,), -1, I32).at[
+        jnp.where(cand, rows, n_rows)].max(fidx, mode="drop")
+    win = cand & (fidx == last[safe])
+    return jnp.where(win, rows, n_rows)
+
+
+def replay_tatp_dense(db0, entries, heads):
+    """Traceable twin of `recover_tatp_dense` over ONE replica's ring
+    view (`tables.log.replica_entries`): rebuilds val + meta from the
+    highest-versioned live entry per row; locks stay volatile exactly
+    like the numpy path. Raises nothing on wrapped rings — the live-slot
+    mask clamps at capacity, so replay is the bounded-window semantics
+    `_flat_entries` enforces by refusal."""
+    import jax.numpy as jnp
+
+    from .engines import tatp_dense as td
+
+    vw = db0.val_words
+    live, flags, key_lo, ver, vals = _replay_columns(entries, heads, vw)
+    is_del = (flags & np.uint32(0xFF)) != 0
+    table = (flags >> np.uint32(8)).astype(np.int32)
+    p1 = int(db0.n_sub) + 1
+    base = jnp.asarray(td._bases(p1))
+    m = db0.meta.shape[0]
+    rows = base[jnp.minimum(table, 4)] + key_lo.astype(np.int32)
+    live = live & (table < 5) & (rows < m)
+    wrows = _replay_winners(rows, ver, live, m)
+    val = db0.val.reshape(-1, vw).at[wrows].set(
+        vals, mode="drop", unique_indices=True)
+    meta = db0.meta.at[wrows].set(
+        (ver << np.uint32(1)) | (~is_del).astype(np.uint32),
+        mode="drop", unique_indices=True)
+    return db0.replace(val=val.reshape(-1), meta=meta)
+
+
+def replay_smallbank_dense(db0, entries, heads):
+    """Traceable twin of `recover_smallbank_dense`: balances from the
+    max-ver entry per row, lock stamp tables reset (volatile), the step
+    counter resumed past the last logged step."""
+    import jax.numpy as jnp
+
+    n_accounts = int(db0.n_accounts)
+    live, flags, key_lo, ver, vals = _replay_columns(entries, heads, 2)
+    table = (flags >> np.uint32(8)).astype(np.int32)
+    rows = table * n_accounts + key_lo.astype(np.int32)
+    live = live & (table < 2) & (key_lo.astype(np.int32) < n_accounts)
+    wrows = _replay_winners(rows, ver, live, db0.bal.shape[0])
+    bal = db0.bal.at[wrows].set(vals[:, 0], mode="drop",
+                                unique_indices=True)
+    next_step = jnp.maximum(
+        jnp.max(jnp.where(live, ver, 0)) + np.uint32(2), np.uint32(2))
+    return db0.replace(bal=bal,
+                       x_step=jnp.zeros_like(db0.x_step),
+                       s_step=jnp.zeros_like(db0.s_step),
+                       step=next_step)
+
+
+def replay_sb_shard(bal0, entries, heads, *, dead: int, n_shards: int):
+    """Traceable twin of `recover_sb_shard`: rebuilds device `dead`'s
+    primary balance range from any one ring carrying its stream (entries
+    log GLOBAL account ids; the dead device's stream is
+    acct % n_shards == dead). `bal0` is the init-balance local array
+    (`m1_local` sized, sentinel last)."""
+    live, flags, key_lo, ver, vals = _replay_columns(entries, heads, 2)
+    table = (flags >> np.uint32(8)).astype(np.int32)
+    acct = key_lo.astype(np.int32)
+    n_loc = (bal0.shape[0] - 1) // 2
+    live = (live & (acct % n_shards == dead) & (table < 2)
+            & (acct // n_shards < n_loc))
+    rows = table * n_loc + acct // n_shards
+    wrows = _replay_winners(rows, ver, live, bal0.shape[0])
+    return bal0.at[wrows].set(vals[:, 0], mode="drop",
+                              unique_indices=True)
 
 
 def recover_smallbank_dense(db0, log_entries, log_heads):

@@ -182,6 +182,25 @@ def append_rep(ring: RepLog, do_append, table_id, is_del, key_hi, key_lo,
     return ring.replace(entries=new_entries, head=ring.head + lane_counts)
 
 
+def advance_watermark(ring: LogRing | RepLog, watermark, consumed):
+    """Advance a ring's durability watermark after `consumed` entries per
+    lane have been checkpointed or replayed downstream.
+
+    The rings themselves wrap unconditionally, exactly like the
+    reference's fixed per-CPU arrays (ls_kern.c:72-73): an append never
+    blocks, and `recovery._flat_entries` refuses a wrapped ring because
+    the overwritten prefix is gone. A caller that snapshots/replays its
+    tables periodically owns a `watermark` u32 [L] ("entries below this
+    head position are durable elsewhere") and advances it here; the ring
+    is then bounded as long as head - watermark <= capacity between
+    advances. No engine threads a watermark yet — that is the ROADMAP
+    log-truncation item, and dintdur's `no-ring-truncation` check keys on
+    exactly this call (the `jnp.minimum` clamp below is the TRUNCATED
+    anchor in analysis/dataflow.py) to flag every ring that appends
+    without one."""
+    return jnp.minimum(ring.head, watermark + consumed.astype(U32))
+
+
 def replica_entries(ring: RepLog, replica: int = 0):
     """One replica's slots in LogRing layout [L, CAP, HDR+VW] (the recovery
     path's input: any single surviving ring suffices)."""

@@ -532,6 +532,12 @@ def _broken_findings(pname):
                             (S((64,), U32), S((8,), I32), S((8,), U32)))
         finally:
             T.TARGET_COST.pop("fixture/cost_budget", None)
+    if pname == "durability":
+        # the canonical broken durability fixture (an engine that
+        # installs certified writes with no log append) lives with the
+        # rest of the dintdur fixtures
+        import test_dintdur
+        return test_dintdur.broken_wal_order_findings()
     raise AssertionError(pname)
 
 

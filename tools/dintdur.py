@@ -1,0 +1,195 @@
+"""dintdur CLI: static durability & recoverability gate.
+
+Runs ONLY the `durability` pass (analysis/passes/durability.py) over the
+registered targets — log-before-visible (wal-order), replica quorum on
+distinct fault domains (quorum-fanout), bounded rings (unbounded-ring /
+no-ring-truncation), replay coverage of everything the engines install
+(replay-coverage), and TIMEOUT totality in the wire coordinator
+(in-doubt-totality) — all proven from the jaxpr + the statically known
+ppermute perms, before any fault is ever injected. Traced with abstract
+values on CPU: no TPU, CI-speed; the jaxpr cache is shared with
+dintlint/dintproof/dintcost (analysis/core.TraceCache). The durability
+fact family (LOG_SLOT/LOGGED/TRUNCATED) and the check catalogue are
+documented in ANALYSIS.md "Durability facts & passes".
+
+Usage:
+    python tools/dintdur.py check --all                  # the CI gate
+    python tools/dintdur.py check --target tatp_dense/block
+    python tools/dintdur.py report --all                 # findings, no gate
+    python tools/dintdur.py report --all --json          # one JSON line
+    python tools/dintdur.py report --all --sarif out.sarif
+    python tools/dintdur.py describe                     # checks + flags
+
+Exit code: 0 when no unsuppressed error-severity finding remains, 1
+otherwise, 2 on usage errors (an unknown --target prints the registered
+names, never a traceback) — dintlint's contract. `report` always exits
+0/2 (it informs; `check` gates). The default allowlist is
+tools/dintlint_allow.json, SHARED with dintlint: one suppression file,
+one written reason per entry, and the only standing durability entry is
+the documented `no-ring-truncation` one (no engine threads a
+checkpoint watermark yet — the ROADMAP log-truncation item).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# same 8-device virtual CPU topology as tests/conftest.py, pinned BEFORE
+# jax initializes backends (the mesh targets need it)
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+from dint_tpu import analysis  # noqa: E402
+from dint_tpu.analysis.passes import durability as _dur  # noqa: E402
+
+DEFAULT_ALLOWLIST = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "dintlint_allow.json")
+
+# bumped when keys of the --json payload change shape
+JSON_SCHEMA = 1
+
+_CHECKS = {
+    "wal-order":
+        "every certified commit-visible install has a log append under "
+        "the same grant mask (write-ahead, never install-without-log)",
+    "quorum-fanout":
+        "replication ppermutes reach >= 2 distinct non-self destinations "
+        "per source; on 2-D meshes the hops ride the dcn (host) axis",
+    "unbounded-ring":
+        "static appends/trace (index width x scan trips) fit the ring's "
+        "slot count",
+    "no-ring-truncation":
+        "a trace that appends also reaches a durability-watermark "
+        "advance (tables/log.advance_watermark); fires on every engine "
+        "until the ROADMAP log-truncation item lands (allowlisted with "
+        "that pointer)",
+    "replay-coverage":
+        "the traceable replay twin rebuilds every table class the engine "
+        "installs, reads the header words the winner rule needs, and "
+        "never reads past the populated entry prefix",
+    "in-doubt-totality":
+        "the wire coordinator detects Reply.TIMEOUT, folds it into the "
+        "alive mask via the in-doubt set, and releases doubted locks "
+        "with an Op.ABORT wave (AST check over the client source)",
+}
+
+
+def _durable_targets():
+    return sorted(n for n, p in analysis.TARGET_PROTOCOL.items()
+                  if _dur.FLAG_DURABLE in p or _dur.FLAG_REPLAY in p)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dintdur", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("mode", choices=["report", "check", "describe"],
+                    help="report: print findings; check: gate (exit 1 on "
+                         "unsuppressed errors); describe: list the "
+                         "checks, flags, and durable targets")
+    ap.add_argument("--all", action="store_true",
+                    help="run every registered target")
+    ap.add_argument("--target", action="append", default=[],
+                    help="target name (repeatable)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one machine-parseable JSON line")
+    ap.add_argument("--sarif", metavar="PATH", default=None,
+                    help="also write the findings as SARIF 2.1.0 to PATH "
+                         "('-' for stdout); allowlisted findings become "
+                         "suppressions (schema: ANALYSIS.md)")
+    ap.add_argument("--allowlist", default=None,
+                    help="allowlist JSON path (default: the shared "
+                         "tools/dintlint_allow.json when present)")
+    args = ap.parse_args(argv)
+
+    if args.mode == "describe":
+        if args.json:
+            print(json.dumps({
+                "metric": "dintdur", "schema": JSON_SCHEMA,
+                "checks": _CHECKS,
+                "flags": {"durable": "engine appends to a replicated "
+                                     "ring; wal/quorum/ring/replay "
+                                     "checks apply",
+                          "replay": "target IS a recovery replay twin; "
+                                    "its entry-column reads are checked"},
+                "durable_targets": _durable_targets(),
+            }), flush=True)
+            return 0
+        print("durability checks (all ERROR severity):")
+        for code, doc in _CHECKS.items():
+            print(f"  {code:20s} {doc}")
+        print("protocol flags (analysis/targets.py):")
+        print("  durable  engine appends to a replicated ring")
+        print("  replay   target is a recovery replay twin")
+        print("durable/replay targets:")
+        for name in _durable_targets():
+            proto = ",".join(analysis.TARGET_PROTOCOL.get(name, ()))
+            print(f"  {name:32s} [{proto}]")
+        return 0
+
+    if not args.all and not args.target:
+        ap.error("pick targets with --target/--all")
+    bad = [n for n in args.target if n not in analysis.TARGETS]
+    if bad:
+        lines = [f"unknown target {n!r}" for n in bad]
+        lines.append("registered targets:")
+        lines += [f"  {n}" for n in sorted(analysis.TARGETS)]
+        ap.error("\n".join(lines))
+
+    allowlist = args.allowlist
+    if allowlist is None and os.path.exists(DEFAULT_ALLOWLIST):
+        allowlist = DEFAULT_ALLOWLIST
+
+    findings = analysis.run(
+        targets=None if args.all else args.target,
+        passes=["durability"],
+        allowlist_path=allowlist)
+
+    failed = args.mode == "check" and analysis.has_errors(findings)
+    if args.sarif:
+        sarif = json.dumps(analysis.to_sarif(findings, ap.prog), indent=1)
+        if args.sarif == "-":
+            print(sarif, flush=True)
+        else:
+            with open(args.sarif, "w") as fh:
+                fh.write(sarif + "\n")
+    if args.json:
+        print(json.dumps({
+            "metric": "dintdur",
+            "schema": JSON_SCHEMA,
+            "mode": args.mode,
+            "targets": (sorted(analysis.TARGETS) if args.all
+                        else args.target),
+            "allowlist": allowlist,
+            "n_findings": len(findings),
+            "n_errors": sum(f.severity == "error" and not f.suppressed
+                            for f in findings),
+            "n_suppressed": sum(f.suppressed for f in findings),
+            "ok": not failed,
+            "findings": [f.to_dict() for f in findings],
+        }), flush=True)
+    else:
+        for f in findings:
+            print(f)
+        n_err = sum(f.severity == "error" and not f.suppressed
+                    for f in findings)
+        n_sup = sum(f.suppressed for f in findings)
+        print(f"dintdur: {len(findings)} finding(s), {n_err} error(s), "
+              f"{n_sup} suppressed -> "
+              f"{'FAIL' if failed else 'ok'}", flush=True)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

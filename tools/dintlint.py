@@ -12,6 +12,7 @@ Usage:
     python tools/dintlint.py --target tatp_dense/block --target sharded/tatp
     python tools/dintlint.py --all --pass scatter_race --pass protocol
     python tools/dintlint.py --all --json             # one JSON line
+    python tools/dintlint.py --all --sarif out.sarif  # SARIF 2.1.0 export
     python tools/dintlint.py --all --time             # wall-time report
     python tools/dintlint.py --all --allowlist tools/dintlint_allow.json
     python tools/dintlint.py --prune-allowlist        # drop stale entries
@@ -103,6 +104,10 @@ def main(argv=None) -> int:
                     help="pass name (repeatable); default: all passes")
     ap.add_argument("--json", action="store_true",
                     help="emit one machine-parseable JSON line")
+    ap.add_argument("--sarif", metavar="PATH", default=None,
+                    help="also write the findings as SARIF 2.1.0 to PATH "
+                         "('-' for stdout); allowlisted findings become "
+                         "suppressions (schema: ANALYSIS.md)")
     ap.add_argument("--time", action="store_true",
                     help="report per-target/per-pass wall time (and embed "
                          "it under 'timing' with --json)")
@@ -192,6 +197,13 @@ def main(argv=None) -> int:
             ap.error(str(e))
 
     failed = analysis.has_errors(findings) or stale
+    if args.sarif:
+        sarif = json.dumps(analysis.to_sarif(findings, ap.prog), indent=1)
+        if args.sarif == "-":
+            print(sarif, flush=True)
+        else:
+            with open(args.sarif, "w") as fh:
+                fh.write(sarif + "\n")
     if args.json:
         payload = {
             "metric": "dintlint",

@@ -13,8 +13,12 @@ then simulates the reference's failure story end-to-end:
      (CommitLog x3, client_ebpf_shard.cc:779-810);
   3. "kill" the device: discard its live tables, keeping only the base
      snapshot + ONE surviving replica's log ring;
-  4. rebuild via recovery.recover_tatp_dense and verify val/ver/exists
-     equality against the true final state for EVERY row.
+  4. rebuild TWICE — the host-side numpy path
+     (recovery.recover_tatp_dense) and the jitted traceable twin
+     (recovery.replay_tatp_dense, the path dintdur's replay-coverage
+     check statically certifies) — and verify val/ver/exists equality
+     of both against the true final state for EVERY row, timing the
+     on-device replay against the host rebuild.
 
 Prints one JSON line and persists artifacts/RECOVERY_<commit>_<ts>.json.
 
@@ -80,10 +84,10 @@ def main():
 
     # device dies here: everything we keep is the snapshot + replica 1's
     # ring (a BACKUP holder's stream — any one of the 3 suffices)
+    surviving = np.asarray(logring.replica_entries(db.log, 1))
+    snap_dev = jax.tree.map(jax.numpy.asarray, snapshot)
     t0 = time.time()
-    rec = recovery.recover_tatp_dense(
-        jax.tree.map(jax.numpy.asarray, snapshot),
-        np.asarray(logring.replica_entries(db.log, 1)), heads)
+    rec = recovery.recover_tatp_dense(snap_dev, surviving, heads)
     equal_val = bool(np.array_equal(np.asarray(rec.val), final_val))
     equal_ver = bool(np.array_equal(np.asarray(rec.ver), final_ver))
     equal_exists = bool(np.array_equal(np.asarray(rec.exists),
@@ -91,11 +95,31 @@ def main():
     rebuild_s = time.time() - t0
     mutated = not np.array_equal(snapshot.ver, final_ver)
 
+    # second rebuild: the jitted traceable twin — one device program,
+    # the exact jaxpr dintdur's replay-coverage check certifies
+    replay_fn = jax.jit(recovery.replay_tatp_dense)
+    t0 = time.time()
+    twin = replay_fn(snap_dev, jax.numpy.asarray(surviving),
+                     jax.numpy.asarray(heads))
+    jax.block_until_ready(twin.val)
+    replay_compile_s = time.time() - t0
+    t0 = time.time()
+    twin = replay_fn(snap_dev, jax.numpy.asarray(surviving),
+                     jax.numpy.asarray(heads))
+    jax.block_until_ready(twin.val)
+    replay_s = time.time() - t0
+    replay_equal = bool(
+        np.array_equal(np.asarray(twin.val), final_val)
+        and np.array_equal(np.asarray(twin.ver), final_ver)
+        and np.array_equal(np.asarray(twin.exists), final_exists))
+
     out = {
         "metric": "tatp_recovery_at_bench_scale",
-        "ok": equal_val and equal_ver and equal_exists and mutated,
+        "ok": (equal_val and equal_ver and equal_exists and mutated
+               and replay_equal),
         "equal_val": equal_val, "equal_ver": equal_ver,
         "equal_exists": equal_exists, "state_mutated": mutated,
+        "replay_twin_equal": replay_equal,
         "n_subscribers": n_sub, "width": w, "window_s": round(dt, 2),
         "blocks": blocks,
         "committed_txns": committed,
@@ -107,6 +131,8 @@ def main():
         "populate_s": round(populate_s, 2),
         "compile_s": round(compile_s, 2),
         "rebuild_s": round(rebuild_s, 2),
+        "replay_compile_s": round(replay_compile_s, 2),
+        "replay_s": round(replay_s, 4),
     }
     try:
         c = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
