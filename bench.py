@@ -134,6 +134,14 @@ def _child_main():
     # so leave it off for headline numbers). Off (default) the engines
     # run the unmonitored jaxpr and the artifact records counters: null.
     monitor_on = os.environ.get("DINT_MONITOR") == "1"
+    # DINT_TRACE=1 threads the dinttrace flight-recorder ring through the
+    # carry (dint_tpu/monitor/txnevents, OBSERVABILITY.md): the artifact
+    # embeds the end-of-run event summary, DINT_TRACE_JSONL=path streams
+    # the decoded per-window events for tools/dinttrace.py, and
+    # DINT_TRACE_RATE tunes the deterministic sampling mask. Off (the
+    # default) the engines run the untraced jaxpr and the artifact
+    # records dinttrace: null.
+    trace_on = os.environ.get("DINT_TRACE") == "1"
     # DINT_USE_PALLAS=1 routes the step's random-access hot ops through the
     # DMA-ring kernels (ops/pallas_gather); the builder's probe degrades to
     # the XLA path on Mosaic rejection, and the retry below additionally
@@ -154,7 +162,7 @@ def _child_main():
         run, init, drain = td.build_pipelined_runner(
             N_SUBSCRIBERS, w=WIDTH, val_words=VAL_WORDS,
             cohorts_per_block=BLOCK, check_magic=check_magic,
-            use_pallas=use_pallas, monitor=monitor_on)
+            use_pallas=use_pallas, monitor=monitor_on, trace=trace_on)
         carry = init(db)
         populate_s = _time.time() - t0
 
@@ -166,11 +174,12 @@ def _child_main():
         stats0 = np.asarray(stats0, np.int64).sum(axis=0) \
             + np.asarray(stats1, np.int64).sum(axis=0)
         compile_s = _time.time() - t0
-        return run, drain, carry, stats0, populate_s, compile_s
+        return run, drain, carry, stats0, populate_s, compile_s, \
+            init.trace_cfg
 
     try:
         (run, drain, carry, stats0,
-         populate_s, compile_s) = build_and_warm(use_pallas)
+         populate_s, compile_s, trace_cfg) = build_and_warm(use_pallas)
     except Exception as e:
         if not use_pallas:
             raise
@@ -181,7 +190,7 @@ def _child_main():
               f"to the XLA path: {e!r}"[:400], file=sys.stderr, flush=True)
         use_pallas = False
         (run, drain, carry, stats0,
-         populate_s, compile_s) = build_and_warm(False)
+         populate_s, compile_s, trace_cfg) = build_and_warm(False)
 
     # dintmon drain loop: per-block wave events when a JSONL path is set
     # (the per-block counter fetch synchronizes the stream — an accepted
@@ -212,6 +221,27 @@ def _child_main():
                 t_prev[0] = now
                 return carry, stats
 
+    # dinttrace drain loop: the ring zeroes at every block entry, so each
+    # block's events must be observed per dispatch; defer=True keeps the
+    # (cap x 16 B) fetch double-buffered off the dispatch critical path
+    # like the counter plane's. Opt-in diagnostic mode — the fetch cost
+    # is real, so leave DINT_TRACE off for headline numbers.
+    tmon = None
+    if trace_on:
+        from dint_tpu.monitor import txnevents as txe
+
+        tmon = txe.TxnMonitor(
+            trace_cfg, path=os.environ.get("DINT_TRACE_JSONL"),
+            meta={"name": "bench_tatp", "width": WIDTH, "block": BLOCK,
+                  "n_subscribers": N_SUBSCRIBERS})
+        ring_ix = -2 if monitor_on else -1
+        traced_run = run
+
+        def run(carry, key, _run=traced_run, _ix=ring_ix):
+            carry, stats = _run(carry, key)
+            tmon.observe(carry[_ix], defer=True)
+            return carry, stats
+
     # host core-seconds strictly over the timed window (warmup above);
     # no device_duty field: the axon platform exposes no honest
     # device-busy counter (block_until_ready returns early), and the
@@ -240,19 +270,26 @@ def _child_main():
 
     if monitor_obj is not None:
         monitor_obj.flush()     # land the deferred final wave event
+    if tmon is not None:
+        tmon.flush()            # land the deferred final event window
     counters_out = None
+    trace_out = None
     if carry is not None:
+        outs = drain(carry)
+        tail, rest = outs[1], list(outs[2:])
+        if trace_on:            # drained boundary cohorts' events
+            tmon.observe(rest.pop(0))
         if monitor_on:
-            _, tail, cnt_final = drain(carry)
             from dint_tpu import monitor as dm
-            counters_out = dm.snapshot(cnt_final)
-        else:
-            _, tail = drain(carry)
+            counters_out = dm.snapshot(rest.pop(0))
         # in-flight cohorts at window end emit their stats on completion
         total = total + np.asarray(tail, np.int64).sum(axis=0)
     elif monitor_obj is not None:
         # carry voided mid-trace: the last per-block snapshot still stands
         counters_out = monitor_obj.prev
+    if tmon is not None:
+        trace_out = tmon.summary()
+        tmon.close()
 
     committed = int(total[td.STAT_COMMITTED])
     attempted = int(total[td.STAT_ATTEMPTED])
@@ -336,6 +373,11 @@ def _child_main():
         # object when DINT_MONITOR=1, EXPLICIT null otherwise — consumers
         # never need to distinguish "off" from "old artifact schema"
         "counters": counters_out,
+        # dinttrace flight-recorder summary, schema-stable: a summary
+        # object when DINT_TRACE=1 (windows/events/dropped — the full
+        # stream goes to DINT_TRACE_JSONL for tools/dinttrace.py),
+        # EXPLICIT null otherwise
+        "dinttrace": trace_out,
         # dintlint --all --json verdict the round ran under (same
         # object-or-explicit-null contract; filled in below so the gate
         # subprocess runs after the measurement window, not inside it)

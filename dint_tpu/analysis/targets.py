@@ -129,23 +129,27 @@ TARGET_FLAT_TWIN: dict[str, str] = {}
 
 def _tatp_dense(name: str, use_pallas: bool, monitor: bool = False,
                 use_hotset: bool = False,
-                use_fused: bool = False) -> TargetTrace:
+                use_fused: bool = False,
+                trace: bool = False) -> TargetTrace:
     from ..engines import tatp_dense as td
     from .. import monitor as mn
+    from ..monitor import txnevents as txe
     run, init, _ = td.build_pipelined_runner(_N_SUB, w=_W, val_words=_VW,
                                              cohorts_per_block=_BLK,
                                              use_pallas=use_pallas,
                                              use_hotset=use_hotset,
                                              use_fused=use_fused,
-                                             monitor=monitor)
+                                             monitor=monitor, trace=trace)
     if use_hotset:
         carry = _abstract(lambda: init(td.create(_N_SUB, val_words=_VW,
                                                  log_capacity=_LOGCAP)))
     else:
-        carry = _abstract(lambda: (td.create(_N_SUB, val_words=_VW,
-                                             log_capacity=_LOGCAP),
-                                   td.empty_ctx(_W), td.empty_ctx(_W))
-                          + ((mn.create(),) if monitor else ()))
+        carry = _abstract(
+            lambda: (td.create(_N_SUB, val_words=_VW,
+                               log_capacity=_LOGCAP),
+                     td.empty_ctx(_W), td.empty_ctx(_W))
+            + ((txe.create_ring(init.trace_cfg.cap),) if trace else ())
+            + ((mn.create(),) if monitor else ()))
     return trace_target(name, run, (carry, _key_aval()))
 
 
@@ -199,14 +203,15 @@ def _t_tatp_dense_drain() -> TargetTrace:
 
 def _sb_dense(name: str, use_pallas: bool, monitor: bool = False,
               use_hotset: bool = False,
-              use_fused: bool = False) -> TargetTrace:
+              use_fused: bool = False,
+              trace: bool = False) -> TargetTrace:
     from ..engines import smallbank_dense as sd
     run, init, _ = sd.build_pipelined_runner(_N_ACCT, w=_W,
                                              cohorts_per_block=_BLK,
                                              use_pallas=use_pallas,
                                              use_hotset=use_hotset,
                                              use_fused=use_fused,
-                                             monitor=monitor)
+                                             monitor=monitor, trace=trace)
     # carry via the runner's own init so the @hot variants get the hot
     # mirror attached exactly as production does
     carry = _abstract(lambda: init(sd.create(_N_ACCT,
@@ -412,13 +417,14 @@ def _t_dense_sharded_mon() -> TargetTrace:
 
 def _dense_sharded_sb(name: str, monitor: bool = False,
                       use_hotset: bool = False,
-                      use_fused: bool = False) -> TargetTrace:
+                      use_fused: bool = False,
+                      trace: bool = False) -> TargetTrace:
     from ..parallel import dense_sharded_sb as dsb
     mesh = _mesh(_MESH_SHARDS)
     run, init, _ = dsb.build_sharded_sb_runner(
         mesh, _MESH_SHARDS, _N_ACCT * _MESH_SHARDS, w=_W,
         cohorts_per_block=_BLK, use_pallas=False, use_hotset=use_hotset,
-        use_fused=use_fused, monitor=monitor)
+        use_fused=use_fused, monitor=monitor, trace=trace)
     carry = _abstract(lambda: init(dsb.create_sharded_sb(
         mesh, _MESH_SHARDS, _N_ACCT * _MESH_SHARDS)))
     return trace_target(name, run, (carry, _key_aval()),
@@ -617,13 +623,14 @@ def _t_dense_sharded_sb_fused_mon() -> TargetTrace:
 
 def _multihost_sb(name: str, n_hosts: int, n_ici: int,
                   hierarchical: bool = True,
-                  monitor: bool = False) -> TargetTrace:
+                  monitor: bool = False,
+                  trace: bool = False) -> TargetTrace:
     from ..parallel import multihost_sb as mhs
     mesh = _mesh2d(n_hosts, n_ici)
     d = n_hosts * n_ici
     run, init, _ = mhs.build_multihost_sb_runner(
         mesh, _N_ACCT * d, w=_W, cohorts_per_block=_BLK,
-        hierarchical=hierarchical, monitor=monitor)
+        hierarchical=hierarchical, monitor=monitor, trace=trace)
     carry = _abstract(lambda: init(mhs.create_multihost_sb(
         mesh, _N_ACCT * d)))
     return trace_target(name, run, (carry, _key_aval()),
@@ -693,6 +700,51 @@ def _t_multihost() -> TargetTrace:
         mesh, _N_SUB * 8, val_words=_VW, log_capacity=_LOGCAP)))
     return trace_target("multihost/block", run, (carry, _key_aval()),
                         mesh_axes=(mhost.DCN_AXIS, mhost.ICI_AXIS))
+
+
+# ------------------------------------------------ flight recorder (@trace)
+# The dinttrace event ring (monitor/txnevents.py) threaded through each
+# instrumented engine at full sampling rate. The ring update is a single
+# provably-unique-index scatter-add (unselected lanes spill to distinct
+# OOB rows dropped by mode="drop"), so the variants pass certification,
+# OCC, and replication checks unchanged; dintcost prices the ring
+# traffic through the per-family "trace" wave rows in monitor/waves.py.
+
+
+@register_target("tatp_dense/block@trace",
+                 "dense TATP with the dinttrace flight-recorder ring "
+                 "(lock/validate/install/outcome events, full rate)",
+                 protocol=('certified', 'occ'))
+def _t_tatp_dense_trace() -> TargetTrace:
+    return _tatp_dense("tatp_dense/block@trace", use_pallas=False,
+                       trace=True)
+
+
+@register_target("smallbank_dense/block@trace",
+                 "dense SmallBank with the dinttrace flight-recorder "
+                 "ring (lock/install/outcome events, full rate)",
+                 protocol=('certified',))
+def _t_sb_dense_trace() -> TargetTrace:
+    return _sb_dense("smallbank_dense/block@trace", use_pallas=False,
+                     trace=True)
+
+
+@register_target("dense_sharded_sb/block@trace",
+                 "multi-chip dense SmallBank with the dinttrace ring: "
+                 "txn ids ride the lock/install routes so owner-side "
+                 "events join into cross-shard span trees",
+                 protocol=('certified', 'replicated'))
+def _t_dense_sharded_sb_trace() -> TargetTrace:
+    return _dense_sharded_sb("dense_sharded_sb/block@trace", trace=True)
+
+
+@register_target("multihost_sb/block@trace",
+                 "2-D multi-host SmallBank with the dinttrace ring: "
+                 "route events carry the dcn-hop tag, replication "
+                 "events land on both fault-domain hops",
+                 protocol=('certified', 'replicated'))
+def _t_multihost_sb_trace() -> TargetTrace:
+    return _multihost_sb("multihost_sb/block@trace", 4, 2, trace=True)
 
 
 # ------------------------------------------------- durability (dintdur)
@@ -855,6 +907,11 @@ _MHSB_FLAT = {
     "dint.multihost_sb.reply": 0.5,
     "dint.multihost_sb.install_route":
         "2*w*l*8 + 2*w*l*4 + w*l*3*(20 + 4*vw)"}
+# The @trace variants route the txn id alongside key+op, widening each
+# lock-route slot from 8 to 12 bytes; install_route's and replicate's
+# extra txn-id field stays inside the base formulas' 25% band.
+_DSB_TRACE = {"dint.dense_sharded_sb.route": "2*w*l*12"}
+_MHSB_TRACE = {"dint.multihost_sb.route": "2*2*w*l*12"}
 # The 2-D TATP runner appends only the LOCAL log copy inside the
 # log_append wave (same deviation _DS_EXPECT documents for the 1-D
 # dense_sharded runner); its replication collectives pre-date wave
@@ -876,8 +933,8 @@ TARGET_COST.update({
     # -> 7 (@pallas) -> 4 (@fused) dispatches/step, bytes flat
     "tatp_dense/block": _cost(_TD_GEOM, 9, 216844),
     "tatp_dense/block@pallas": _cost(_TD_GEOM, 7, 216844),
-    "tatp_dense/block@mon": _cost(_TD_GEOM, 11, 216960),
-    "tatp_dense/block@mon+pallas": _cost(_TD_GEOM, 10, 216960,
+    "tatp_dense/block@mon": _cost(_TD_GEOM, 11, 216964),
+    "tatp_dense/block@mon+pallas": _cost(_TD_GEOM, 10, 216964,
                                          wave_expect=_MONPL_TD),
     "tatp_dense/drain": _cost(_TD_GEOM, 9, 216836),
     "tatp_dense/block@hot": _cost(_TD_GEOM, 13, 216864,
@@ -886,28 +943,28 @@ TARGET_COST.update({
     "tatp_dense/block@fused": _cost(_TD_GEOM, 4, 216844),
     "tatp_dense/block@fused+hot": _cost(_TD_GEOM, 5, 216864,
                                         wave_expect=_TD_FUSED_HOT),
-    "tatp_dense/block@fused+mon": _cost(_TD_GEOM, 7, 216960),
+    "tatp_dense/block@fused+mon": _cost(_TD_GEOM, 7, 216964),
     # dense SmallBank: 8 -> 5 dispatches/step under the megakernels
     "smallbank_dense/block": _cost(_SB_GEOM, 8, 150984),
     "smallbank_dense/block@pallas": _cost(_SB_GEOM, 8, 150984),
-    "smallbank_dense/block@mon": _cost(_SB_GEOM, 10, 151100),
+    "smallbank_dense/block@mon": _cost(_SB_GEOM, 10, 151104),
     "smallbank_dense/block@hot": _cost(_SB_GEOM, 14, 151032,
                                        wave_expect=_HOT2_SB),
     "smallbank_dense/block@hot+pallas": _cost(_SB_GEOM, 10, 151032),
-    "smallbank_dense/block@hot+mon": _cost(_SB_GEOM, 16, 151148,
+    "smallbank_dense/block@hot+mon": _cost(_SB_GEOM, 16, 151152,
                                            wave_expect=_HOT2_SB),
     "smallbank_dense/block@fused": _cost(_SB_GEOM, 5, 150984),
     "smallbank_dense/block@fused+hot": _cost(_SB_GEOM, 7, 151032),
-    "smallbank_dense/block@fused+mon": _cost(_SB_GEOM, 7, 151100),
+    "smallbank_dense/block@fused+mon": _cost(_SB_GEOM, 7, 151104),
     # generic pipelines: sort-bound, no formula-backed waves -> absolute
     # bytes ceilings instead of a ledger multiple
     "tatp_pipeline/block": _cost(_TD_GEOM, 50, 1610736022,
                                  bytes_budget=256000),
-    "tatp_pipeline/block@mon": _cost(_TD_GEOM, 51, 1610736138,
+    "tatp_pipeline/block@mon": _cost(_TD_GEOM, 51, 1610736142,
                                      bytes_budget=256000),
     "smallbank_pipeline/block": _cost(_SB_GEOM, 36, 1207967480,
                                       bytes_budget=72000),
-    "smallbank_pipeline/block@mon": _cost(_SB_GEOM, 37, 1207967596,
+    "smallbank_pipeline/block@mon": _cost(_SB_GEOM, 37, 1207967600,
                                           bytes_budget=72000),
     # generic replicated shard step: one engine step per trace
     "sharded/tatp": _cost(_DS_GEOM, 62, 4295279296, steps=1.0,
@@ -919,21 +976,21 @@ TARGET_COST.update({
                                  wave_expect=_DS_EXPECT),
     "dense_sharded/block@pallas": _cost(_DS_GEOM, 31, 459240,
                                         wave_expect=_DS_EXPECT),
-    "dense_sharded/block@mon": _cost(_DS_GEOM, 37, 459704,
+    "dense_sharded/block@mon": _cost(_DS_GEOM, 37, 459720,
                                      wave_expect=_DS_EXPECT),
     "dense_sharded/block@fused": _cost(_DS_GEOM, 28, 459240,
                                        wave_expect=_DS_EXPECT_FUSED),
-    "dense_sharded/block@fused+mon": _cost(_DS_GEOM, 33, 459704,
+    "dense_sharded/block@fused+mon": _cost(_DS_GEOM, 33, 459720,
                                            wave_expect=_DS_EXPECT_FUSED),
     # dense multi-chip SmallBank: 33 -> 30 dispatches/step fused
     "dense_sharded_sb/block": _cost(_DSB_GEOM, 33, 100676560),
-    "dense_sharded_sb/block@mon": _cost(_DSB_GEOM, 37, 100677024),
+    "dense_sharded_sb/block@mon": _cost(_DSB_GEOM, 37, 100677040),
     "dense_sharded_sb/block@hot": _cost(_DSB_GEOM, 39, 100676848,
                                         wave_expect=_DSB_HOT),
     "dense_sharded_sb/block@fused": _cost(_DSB_GEOM, 30, 100676560),
     "dense_sharded_sb/block@fused+hot": _cost(
         _DSB_GEOM, 32, 100676848, wave_expect=_DSB_FUSED_HOT),
-    "dense_sharded_sb/block@fused+mon": _cost(_DSB_GEOM, 34, 100677024),
+    "dense_sharded_sb/block@fused+mon": _cost(_DSB_GEOM, 34, 100677040),
     # 2-D (dcn x ici) SmallBank: the hierarchical route pays +9
     # dispatches/step (each exchange runs ici + dcn stages) to move
     # strictly fewer DCN-axis link bytes than its flat twin — the
@@ -942,7 +999,7 @@ TARGET_COST.update({
     "multihost_sb/block": _cost(_MHSB_GEOM, 42, 201353056),
     "multihost_sb/block@flat": _cost(_MHSB_GEOM, 33, 201353056,
                                      wave_expect=_MHSB_FLAT),
-    "multihost_sb/block@mon": _cost(_MHSB_GEOM, 46, 201353984),
+    "multihost_sb/block@mon": _cost(_MHSB_GEOM, 46, 201354016),
     "multihost_sb/block@h3": _cost(_MHSB_GEOM_H3, 42, 151014808),
     "multihost_sb/block@h3+flat": _cost(_MHSB_GEOM_H3, 33, 151014808,
                                         wave_expect=_MHSB_FLAT),
@@ -952,6 +1009,16 @@ TARGET_COST.update({
     "multihost/block": _cost(dict(w=_W, k=4, vw=_VW, d=8, h=4), 33,
                              918424, bytes_budget=11000,
                              wave_expect=_MH_EXPECT),
+    # dinttrace flight-recorder variants: the ring scatter-add adds one
+    # dispatch per step plus the txn-id route fields (per-family "trace"
+    # wave rows in monitor/waves.py price the 16 B x candidate-lane
+    # update operand); footprint grows by the per-device ring buffers
+    "tatp_dense/block@trace": _cost(_TD_GEOM, 10, 221968),
+    "smallbank_dense/block@trace": _cost(_SB_GEOM, 9, 154572),
+    "dense_sharded_sb/block@trace": _cost(_DSB_GEOM, 38, 100735968,
+                                          wave_expect=_DSB_TRACE),
+    "multihost_sb/block@trace": _cost(_MHSB_GEOM, 49, 201471872,
+                                      wave_expect=_MHSB_TRACE),
     # recovery replay twins (cold path, one invocation per fault — the
     # budget exists so replay cannot silently grow a per-entry dispatch
     # loop): no waves.py formulas, absolute bytes ceilings like the

@@ -77,6 +77,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..monitor import counters as mon
+from ..monitor import txnevents as txe
 from ..monitor import waves
 from ..ops import pallas_gather as pg
 from ..tables import log as logring
@@ -237,7 +238,9 @@ def pipe_step(db: DenseBank, c1: BankCtx, key, *, w: int, n_accounts: int,
               gen_new: bool = True, hot_frac=None, hot_prob=None, mix=None,
               use_pallas: bool = False, use_hotset: bool = False,
               use_fused: bool = False,
-              counters: mon.Counters | None = None):
+              counters: mon.Counters | None = None,
+              ring: txe.TxnRing | None = None,
+              tcfg: txe.TraceCfg | None = None):
     """One fused device step: wave 1 of a NEW cohort acquires against c1's
     STILL-HELD stamps (stamp == step-1), then wave 2 installs c1's writes.
     Returns (db', new_ctx, stats-of-c1).
@@ -278,7 +281,13 @@ def pipe_step(db: DenseBank, c1: BankCtx, key, *, w: int, n_accounts: int,
     (held-slot rejects split from intra-batch losses), install/log
     counts, ring high-water, backend dispatch. When threaded the updated
     Counters is appended to the return tuple; None (default) leaves the
-    jaxpr untouched."""
+    jaxpr untouched.
+
+    ``ring``/``tcfg`` (monitor.txnevents): the dinttrace flight-recorder
+    plane — lock verdicts, installs, and outcome classifications of the
+    deterministically sampled txn-id subset land in the per-device event
+    ring with one scatter-add per step. The updated TxnRing is appended
+    AFTER the Counters leaf; None (default) adds nothing to the jaxpr."""
     m1 = 2 * n_accounts + 1
     sent = m1 - 1
     oob = m1
@@ -487,6 +496,40 @@ def pipe_step(db: DenseBank, c1: BankCtx, key, *, w: int, n_accounts: int,
     db = db.replace(bal=bal_new, x_step=x_step, s_step=s_step,
                     step=t + 1, log=logs, hot_bal=hot_bal,
                     hot_x=hot_x, hot_s=hot_s)
+    extra = ()
+    if ring is not None:
+        # dinttrace: this step's candidate events — lock verdicts of the
+        # NEW cohort (txn id = gen_step*w + lane, stable across waves),
+        # its outcome classification, and c1's landing installs — in ONE
+        # sampled scatter-add (monitor/txnevents.emit)
+        with waves.scope("smallbank_dense", "trace"):
+            tu = jnp.asarray(t).astype(U32)
+            lane_w = jnp.arange(w, dtype=U32)
+            txn_new = tu * U32(w) + lane_w
+            txn_c1 = (tu - U32(1)) * U32(w) + lane_w
+            grant_l = (grant_x | grant_s)
+            held_l = held_x | held_s
+            lock_aux = (jnp.where(grant_l, txe.LOCK_GRANTED, 0)
+                        | jnp.where(held_l, txe.LOCK_HELD, 0))
+            ab_lock_m = lock_rejected & (l_op[:, 0] != 0)
+            out_mask = committed | ab_lock_m | logic_abort
+            cause = jnp.where(
+                ab_lock_m, txe.CAUSE_LOCK,
+                jnp.where(logic_abort, txe.CAUSE_LOGIC, txe.CAUSE_COMMIT))
+            groups = (
+                txe.ev(active.reshape(-1), jnp.repeat(txn_new, L),
+                       txe.EV_LOCK,
+                       waves.full_name("smallbank_dense", "lock"),
+                       aux=lock_aux, step=tu),
+                txe.ev(out_mask, txn_new, txe.EV_OUTCOME,
+                       waves.full_name("smallbank_dense", "compute"),
+                       aux=cause, step=tu),
+                txe.ev(dwf, jnp.repeat(txn_c1, L), txe.EV_INSTALL,
+                       waves.full_name("smallbank_dense", "install"),
+                       step=tu),
+            )
+            ring, counters = txe.emit(ring, tcfg, groups, counters)
+        extra = (ring,)
     if counters is not None:
         act_l = active.reshape(-1)
         grant_l = granted.reshape(-1)
@@ -529,15 +572,16 @@ def pipe_step(db: DenseBank, c1: BankCtx, key, *, w: int, n_accounts: int,
         })
         counters = mon.gauge_max(
             counters, {mon.CTR_RING_HWM: logs.head.max()})
-        return db, new_ctx, _stats_of(c1), counters
-    return db, new_ctx, _stats_of(c1)
+        return (db, new_ctx, _stats_of(c1), counters) + extra
+    return (db, new_ctx, _stats_of(c1)) + extra
 
 
 def build_pipelined_runner(n_accounts: int, w: int = 8192,
                            cohorts_per_block: int = 8, hot_frac=None,
                            hot_prob=None, mix=None, use_pallas=None,
                            use_hotset=None, use_fused=None,
-                           monitor: bool = False):
+                           monitor: bool = False, trace=None,
+                           trace_rate=None, trace_cap=None):
     """jit(scan(pipe_step)) over carry (db, c1). Returns (run, init, drain):
       run(carry, key) -> (carry', stats [cohorts_per_block, N_STATS])
       init(db)        -> carry with one bootstrap cohort in flight
@@ -563,6 +607,14 @@ def build_pipelined_runner(n_accounts: int, w: int = 8192,
     ``monitor``: thread the dintmon counter plane — the carry grows a
     trailing monitor.Counters leaf and drain returns (db, stats,
     counters); off (default) = contract and jaxpr unchanged.
+
+    ``trace``/``trace_rate``/``trace_cap``: thread the dinttrace event
+    ring (None = honor DINT_TRACE / DINT_TRACE_RATE). The carry grows a
+    TxnRing leaf BEFORE the Counters leaf (counters stay carry[-1]); the
+    ring is zeroed at each block/drain entry so every drained window is
+    self-contained, and `init.trace_cfg` exposes the resolved TraceCfg
+    (None when off) for the host-side drain. Default capacity is
+    lossless for a full block: candidate lanes/step x cohorts_per_block.
     """
     from ..clients import workloads as wl
     use_hotset = pg.resolve_use_hotset(use_hotset)
@@ -584,19 +636,39 @@ def build_pipelined_runner(n_accounts: int, w: int = 8192,
     kw = dict(w=w, n_accounts=n_accounts, use_pallas=use_pallas,
               use_hotset=use_hotset, use_fused=use_fused)
     kw_gen = dict(kw, hot_frac=hot_frac, hot_prob=hot_prob, mix=mix)
+    trace_on = txe.trace_enabled(trace)
+    tcfg = None
+    if trace_on:
+        n_step = w * (2 * L + 1)    # lock wL + outcome w + install wL
+        cap = int(trace_cap) if trace_cap is not None \
+            else n_step * cohorts_per_block
+        tcfg = txe.TraceCfg(rate=txe.trace_rate(trace_rate), cap=cap,
+                            wave=waves.full_name("smallbank_dense",
+                                                 "trace"))
 
-    def step_mon(db, c1, key, cnt, **skw):
-        out = pipe_step(db, c1, key, counters=cnt, **skw)
-        return out if cnt is not None else out + (None,)
+    def step_mon(db, c1, key, cnt, ring, **skw):
+        out = pipe_step(db, c1, key, counters=cnt, ring=ring, tcfg=tcfg,
+                        **skw)
+        i = 3
+        cnt = out[i] if cnt is not None else None
+        i += 1 if monitor else 0
+        ring = out[i] if ring is not None else None
+        return out[0], out[1], out[2], cnt, ring
 
     def scan_fn(carry, key):
         db, c1 = carry[:2]
-        cnt = carry[2] if monitor else None
-        db, new_ctx, stats, cnt = step_mon(db, c1, key, cnt, **kw_gen)
-        out = (db, new_ctx) + ((cnt,) if monitor else ())
+        ring = carry[2] if trace_on else None
+        cnt = carry[-1] if monitor else None
+        db, new_ctx, stats, cnt, ring = step_mon(db, c1, key, cnt, ring,
+                                                 **kw_gen)
+        out = ((db, new_ctx) + ((ring,) if trace_on else ())
+               + ((cnt,) if monitor else ()))
         return out, stats
 
     def block(carry, key):
+        if trace_on:
+            # each block is one drain window: self-contained ring
+            carry = carry[:2] + (txe.reset(carry[2]),) + carry[3:]
         keys = jax.random.split(key, cohorts_per_block)
         return jax.lax.scan(scan_fn, carry, keys)
 
@@ -604,16 +676,19 @@ def build_pipelined_runner(n_accounts: int, w: int = 8192,
         if use_hotset and db.hot_n == 0:
             db = attach_hotset(db, hot_n)
         base = (db, empty_ctx(w))
-        return base + ((mon.create(),) if monitor else ())
+        return (base + ((txe.create_ring(tcfg.cap),) if trace_on else ())
+                + ((mon.create(),) if monitor else ()))
 
     @functools.partial(jax.jit, donate_argnums=0)
     def drain(carry):
         db, c1 = carry[:2]
-        cnt = carry[2] if monitor else None
-        db, _, s1, cnt = step_mon(db, c1, jax.random.PRNGKey(0),
-                                  cnt, gen_new=False, **kw)
-        if monitor:
-            return db, jnp.stack([s1]), cnt
-        return db, jnp.stack([s1])
+        ring = txe.reset(carry[2]) if trace_on else None
+        cnt = carry[-1] if monitor else None
+        db, _, s1, cnt, ring = step_mon(db, c1, jax.random.PRNGKey(0),
+                                        cnt, ring, gen_new=False, **kw)
+        return ((db, jnp.stack([s1]))
+                + ((ring,) if trace_on else ())
+                + ((cnt,) if monitor else ()))
 
+    init.trace_cfg = tcfg
     return jax.jit(block, donate_argnums=0), init, drain

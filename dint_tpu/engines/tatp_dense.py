@@ -100,6 +100,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..monitor import counters as mon
+from ..monitor import txnevents as txe
 from ..monitor import waves
 from ..ops import pallas_gather as pg
 from ..tables import log as logring
@@ -398,7 +399,9 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
               emit_installs: bool = False, check_magic: bool = True,
               use_pallas: bool = False, use_hotset: bool = False,
               use_fused: bool = False,
-              counters: mon.Counters | None = None):
+              counters: mon.Counters | None = None,
+              ring: txe.TxnRing | None = None,
+              tcfg: txe.TraceCfg | None = None):
     """One fused device step: commit wave of c2, validate wave of c1, and
     read+lock wave of a NEW cohort — ordered commits -> reads -> locks per
     row exactly like the generic engine's phase order (engines/tatp.
@@ -442,7 +445,14 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
     install/log counts, ring high-water, backend dispatch) with
     unique-index scatter-adds and returns the updated Counters appended
     to the result tuple. None (the default) threads no counter state and
-    leaves the jaxpr untouched."""
+    leaves the jaxpr untouched.
+
+    ``ring``/``tcfg`` (monitor.txnevents): the dinttrace flight-recorder
+    plane — the new cohort's lock verdicts and wave-1 outcomes, c1's
+    validate verdicts and wave-2 outcomes, and c2's landing installs for
+    the deterministically sampled txn-id subset, ONE scatter-add per
+    step. The updated TxnRing is appended LAST (after Counters and the
+    Installs record); None (default) adds nothing to the jaxpr."""
     p1 = n_sub + 1
     n1 = n_rows(n_sub) + 1
     sent = n1 - 1     # sentinel row: gathered by NOP lanes, never written
@@ -577,7 +587,7 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
                                 sent)                           # [w, 2]
             flat_ws = ws_rows.reshape(-1)
             active = ws_active.reshape(-1)
-            if counters is not None:
+            if counters is not None or ring is not None:
                 # won-vs-lost split needs the pre-arbitration stamps, read
                 # BEFORE the kernel aliases arb in place (read-before-
                 # donate, same as the unfused pallas route)
@@ -613,13 +623,18 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
 
     # ---- wave 2 of c1: validate read-set version compare ------------------
     changed = bad.any(axis=1)
-    if counters is not None:
+    if counters is not None or ring is not None:
         # lanes of surviving RW txns checked / failed — the same lane set
         # the generic pipeline re-reads (_validate_lanes), so the parity
-        # counters are engine-independent
+        # counters are engine-independent. The flight recorder needs the
+        # per-lane masks (and c1's PRE-verdict alive) for its VALIDATE
+        # and wave-2 OUTCOME events, captured before the replace below.
         v_alive = c1.alive[:, None]
         v_lanes = (c1.is_read & v_alive).sum(dtype=I32)
         v_failed = (bad & v_alive).sum(dtype=I32)
+        val_mask = (c1.is_read & v_alive).reshape(-1)       # [wK]
+        val_bad = (bad & v_alive).reshape(-1)               # [wK]
+        c1_alive_pre = c1.alive
     c1 = c1.replace(alive=c1.alive & ~changed,
                     ab_validate=(c1.alive & changed).sum(dtype=I32))
 
@@ -663,7 +678,7 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
             flat_ws = ws_rows.reshape(-1)
             active = ws_active.reshape(-1)
             if use_pallas:
-                if counters is not None:
+                if counters is not None or ring is not None:
                     # the fused kernel only exposes winners; the
                     # won-vs-lost split needs the pre-arbitration stamps,
                     # read BEFORE the kernel aliases arb in place (a
@@ -769,6 +784,50 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
         })
         counters = mon.gauge_max(
             counters, {mon.CTR_RING_HWM: logs.head.max()})
+    extra = ()
+    if ring is not None:
+        # dinttrace: the txn id is recomputable per cohort — gen_step*w +
+        # lane (c1 generated at t-1, c2 at t-2), so the assembler joins a
+        # txn's lock, validate, install, and outcome events without any
+        # id traveling through the carry. The OUTCOME masks mirror the
+        # counter formulas above exactly (ro commits + lock/missing
+        # aborts classify at wave 1; rw commits + validate aborts at
+        # wave 2), so full-rate event counts reconcile with the ledger.
+        with waves.scope("tatp_dense", "trace"):
+            tu = jnp.asarray(t).astype(U32)
+            lane_w = jnp.arange(w, dtype=U32)
+            txn_new = tu * U32(w) + lane_w
+            txn_c1 = (tu - U32(1)) * U32(w) + lane_w
+            txn_c2 = (tu - U32(2)) * U32(w) + lane_w
+            grant_l = grant.reshape(-1)
+            lock_aux = (jnp.where(grant_l, txe.LOCK_GRANTED, 0)
+                        | jnp.where(held, txe.LOCK_HELD, 0))
+            miss_m = (rw & ~lock_rejected & missing) | (is_ro & missing)
+            out1_mask = (rw & lock_rejected) | miss_m | new_ctx.ro_commit
+            out1_cause = jnp.where(
+                rw & lock_rejected, txe.CAUSE_LOCK,
+                jnp.where(miss_m, txe.CAUSE_MISSING, txe.CAUSE_COMMIT))
+            out2_cause = jnp.where(changed, txe.CAUSE_VALIDATE,
+                                   txe.CAUSE_COMMIT)
+            groups = (
+                txe.ev(active, jnp.repeat(txn_new, 2), txe.EV_LOCK,
+                       waves.full_name("tatp_dense", "lock"),
+                       aux=lock_aux, step=tu),
+                txe.ev(val_mask, jnp.repeat(txn_c1, K), txe.EV_VALIDATE,
+                       waves.full_name("tatp_dense", "meta_gather"),
+                       aux=val_bad, step=tu),
+                txe.ev(wmask, jnp.repeat(txn_c2, 2), txe.EV_INSTALL,
+                       waves.full_name("tatp_dense", "install"),
+                       step=tu),
+                txe.ev(out1_mask, txn_new, txe.EV_OUTCOME,
+                       waves.full_name("tatp_dense", "lock"),
+                       aux=out1_cause, step=tu),
+                txe.ev(c1_alive_pre, txn_c1, txe.EV_OUTCOME,
+                       waves.full_name("tatp_dense", "meta_gather"),
+                       aux=out2_cause, step=tu),
+            )
+            ring, counters = txe.emit(ring, tcfg, groups, counters)
+        extra = (ring,)
     if emit_installs:
         inst = Installs(
             wmask=wmask, rows=c2.ws_rows.reshape(-1),
@@ -776,11 +835,11 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
             val=newval, tbl=log_tbl, key=log_key,
             is_del=flags_del, ver=newver)
         if counters is not None:
-            return db, new_ctx, c1, _stats_of(c2), inst, counters
-        return db, new_ctx, c1, _stats_of(c2), inst
+            return (db, new_ctx, c1, _stats_of(c2), inst, counters) + extra
+        return (db, new_ctx, c1, _stats_of(c2), inst) + extra
     if counters is not None:
-        return db, new_ctx, c1, _stats_of(c2), counters
-    return db, new_ctx, c1, _stats_of(c2)
+        return (db, new_ctx, c1, _stats_of(c2), counters) + extra
+    return (db, new_ctx, c1, _stats_of(c2)) + extra
 
 
 def rebase_stamps(db: DenseDB) -> DenseDB:
@@ -805,7 +864,8 @@ def build_pipelined_runner(n_sub: int, w: int = 8192, val_words: int = 10,
                            check_magic: bool = True, use_pallas=None,
                            use_hotset: bool = False, hot_frac=None,
                            use_fused=None, log_replicas: int = N_SHARDS,
-                           monitor: bool = False):
+                           monitor: bool = False, trace=None,
+                           trace_rate=None, trace_cap=None):
     """jit(scan(pipe_step)) over carry (db, c1, c2); same contract as
     tatp_pipeline.build_pipelined_runner: returns (run, init, drain).
 
@@ -832,7 +892,17 @@ def build_pipelined_runner(n_sub: int, w: int = 8192, val_words: int = 10,
     carry grows a trailing monitor.Counters leaf (init creates it; read
     it between dispatches with monitor.snapshot(carry[-1])) and drain
     returns (db, stats, counters). Off (default) = contract and jaxpr
-    unchanged, outputs bit-identical."""
+    unchanged, outputs bit-identical.
+
+    ``trace`` / ``trace_rate`` / ``trace_cap``: the dinttrace flight
+    recorder (None = honor DINT_TRACE / DINT_TRACE_RATE). When on, the
+    carry gains a monitor.txnevents.TxnRing leaf BEFORE the counters leaf
+    (so counters stay carry[-1]); each block starts from a zeroed ring and
+    the caller drains it between dispatches with monitor.txnevents
+    .TxnMonitor.observe. ``trace_cap`` defaults to one full block of
+    candidates (w*(K+6) per step) so nothing drops at rate 1.0; the
+    resolved txnevents.TraceCfg hangs off ``init.trace_cfg``. Off =
+    engine outputs bit-identical, not one extra jaxpr eqn."""
     assert 2 * w <= (1 << K_ARB), f"w={w} exceeds the arb slot field"
     use_hotset = bool(use_hotset)
     use_pallas = pg.resolve_use_pallas(use_pallas, n_idx=2 * w * K,
@@ -856,44 +926,67 @@ def build_pipelined_runner(n_sub: int, w: int = 8192, val_words: int = 10,
     kw = dict(w=w, n_sub=n_sub, val_words=val_words,
               check_magic=check_magic, use_pallas=use_pallas,
               use_hotset=use_hotset, use_fused=use_fused)
+    trace_on = txe.trace_enabled(trace)
+    tcfg = None
+    if trace_on:
+        # candidates/step: LOCK [2w] + VALIDATE [wK] + INSTALL [2w] +
+        # OUTCOME x2 [2w] — default cap holds a full block at rate 1.0
+        n_step = w * (K + 6)
+        cap = int(trace_cap) if trace_cap else n_step * cohorts_per_block
+        tcfg = txe.TraceCfg(rate=txe.trace_rate(trace_rate), cap=cap,
+                            wave=waves.full_name("tatp_dense", "trace"))
 
-    def step_mon(db, c1, c2, key, cnt, **skw):
-        """pipe_step + (counters or None), normalized to a fixed arity."""
-        out = pipe_step(db, c1, c2, key, counters=cnt, **skw)
-        return out if cnt is not None else out + (None,)
+    def step_mon(db, c1, c2, key, cnt, ring, **skw):
+        """pipe_step with counters/ring or None, normalized to a fixed
+        6-arity (db, new_ctx, c1, stats, cnt, ring)."""
+        out = pipe_step(db, c1, c2, key, counters=cnt, ring=ring,
+                        tcfg=tcfg, **skw)
+        i = 4
+        cnt = out[i] if cnt is not None else None
+        i += 1 if cnt is not None else 0
+        ring = out[i] if ring is not None else None
+        return out[0], out[1], out[2], out[3], cnt, ring
 
     def scan_fn(carry, key):
         db, c1, c2 = carry[:3]
-        cnt = carry[3] if monitor else None
-        db, new_ctx, c1, stats, cnt = step_mon(db, c1, c2, key, cnt,
-                                               mix=mix, **kw)
-        out = (db, new_ctx, c1) + ((cnt,) if monitor else ())
+        ring = carry[3] if trace_on else None
+        cnt = carry[-1] if monitor else None
+        db, new_ctx, c1, stats, cnt, ring = step_mon(
+            db, c1, c2, key, cnt, ring, mix=mix, **kw)
+        out = ((db, new_ctx, c1) + ((ring,) if trace_on else ())
+               + ((cnt,) if monitor else ()))
         return out, stats
 
     def block(carry, key):
         db = jax.lax.cond(carry[0].step >= U32(REBASE_AT), rebase_stamps,
                           lambda d: d, carry[0])
+        carry = (db,) + carry[1:]
+        if trace_on:     # each drained window is self-contained
+            carry = carry[:3] + (txe.reset(carry[3]),) + carry[4:]
         keys = jax.random.split(key, cohorts_per_block)
-        return jax.lax.scan(scan_fn, (db,) + carry[1:], keys)
+        return jax.lax.scan(scan_fn, carry, keys)
 
     def init(db):
         if use_hotset and db.hot_n == 0:
             db = attach_hotset(db, hot_rows)
         base = (db, empty_ctx(w), empty_ctx(w))
-        return base + ((mon.create(),) if monitor else ())
+        return (base + ((txe.create_ring(tcfg.cap),) if trace_on else ())
+                + ((mon.create(),) if monitor else ()))
+
+    init.trace_cfg = tcfg
 
     @functools.partial(jax.jit, donate_argnums=0)
     def drain(carry):
         db, c1, c2 = carry[:3]
-        cnt = carry[3] if monitor else None
+        ring = txe.reset(carry[3]) if trace_on else None
+        cnt = carry[-1] if monitor else None
         key = jax.random.PRNGKey(0)
-        db, _, c1, s1, cnt = step_mon(db, c1, c2, key, cnt,
-                                      gen_new=False, **kw)
-        db, _, _, s2, cnt = step_mon(db, empty_ctx(w), c1, key, cnt,
-                                     gen_new=False, **kw)
+        db, _, c1, s1, cnt, ring = step_mon(db, c1, c2, key, cnt, ring,
+                                            gen_new=False, **kw)
+        db, _, _, s2, cnt, ring = step_mon(db, empty_ctx(w), c1, key, cnt,
+                                           ring, gen_new=False, **kw)
         stats = jnp.stack([s1, s2])
-        if monitor:
-            return db, stats, cnt
-        return db, stats
+        return ((db, stats) + ((ring,) if trace_on else ())
+                + ((cnt,) if monitor else ()))
 
     return jax.jit(block, donate_argnums=0), init, drain

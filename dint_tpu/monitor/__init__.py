@@ -50,9 +50,12 @@ from .counters import (CTR_STEPS, CTR_TXN_ATTEMPTED,  # noqa: F401
                        CTR_REPL_PUSH_HOP2, CTR_ROUTE_OVERFLOW,
                        CTR_RING_HWM, CTR_DISPATCH_XLA, CTR_DISPATCH_PALLAS,
                        CTR_HOT_HITS, CTR_HOT_COLD_ROWS,
-                       CTR_HOT_REFRESH_BYTES)
+                       CTR_HOT_REFRESH_BYTES, CTR_TRACE_DROPPED)
 from .trace import (Monitor, TraceWriter, export_chrome_trace,  # noqa: F401
                     profiler_session, read_events)
 # dintscope (the timing half): wave registry + trace attribution — import
 # as modules so the counter namespace above stays flat and unambiguous
 from . import attrib, waves  # noqa: F401, E402
+# dinttrace (the narration half): per-txn event ring + span assembler —
+# module imports for the same reason
+from . import txnevents, txntrace  # noqa: F401, E402

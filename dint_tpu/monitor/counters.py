@@ -118,6 +118,11 @@ _REGISTRY: tuple[tuple[str, str, str], ...] = (
      "routed lanes (lock requests + installs) whose owner lives on "
      "ANOTHER host: the exchange pays the DCN hop (2-D sharded "
      "SmallBank)"),
+    ("trace_dropped", FLOW,
+     "dinttrace events lost to ring overflow: sampled events generated "
+     "after the per-window event ring filled (keep-first semantics — "
+     "the ring never wraps over recorded events, the excess is dropped "
+     "and counted here; 0 whenever the ring is sized for the window)"),
 )
 
 ALL_NAMES: tuple[str, ...] = tuple(n for n, _, _ in _REGISTRY)
@@ -157,6 +162,7 @@ CTR_HOT_REFRESH_BYTES = COUNTER_INDEX["hot_refresh_bytes"]
 CTR_FUSED_DISPATCH = COUNTER_INDEX["fused_dispatch"]
 CTR_ROUTE_ICI_LANES = COUNTER_INDEX["route_ici_lanes"]
 CTR_ROUTE_DCN_LANES = COUNTER_INDEX["route_dcn_lanes"]
+CTR_TRACE_DROPPED = COUNTER_INDEX["trace_dropped"]
 
 # the subset defined with IDENTICAL semantics by the dense engines and
 # the generic sort-based pipelines: on the parity workloads

@@ -208,6 +208,27 @@ _REGISTRY: tuple[tuple[str, str, str, str | None], ...] = (
      "h+1/h+2 at the same chip (axis=dcn), apply to backup copies + "
      "append local logs (2 hops x wL balance rows + a log append each)",
      "2*(w*l*4 + w*l*3*(20 + 4*vw))"),
+    # --- dinttrace flight recorder (monitor/txnevents.py): one
+    # --- concatenated 16-byte-record scatter-add into the per-device
+    # --- event ring per step, covering every instrumented wave of the
+    # --- engine. Formula = 16 B x candidate event lanes per step
+    # --- (sampling masks lanes out of the scatter but the update
+    # --- operand — what dintcost prices — stays full-width) ------------
+    ("tatp_dense", "trace",
+     "flight-recorder event scatter: LOCK (2w) + VALIDATE (wK) + "
+     "INSTALL (2w) + OUTCOME x2 (2w) candidate records per step",
+     "16*(w*(k+6))"),
+    ("smallbank_dense", "trace",
+     "flight-recorder event scatter: LOCK (wL) + INSTALL (wL) + "
+     "OUTCOME (w) candidate records per step", "16*(w*(2*l+1))"),
+    ("dense_sharded_sb", "trace",
+     "flight-recorder event scatter: ROUTE (wL) + owner LOCK (2wL) + "
+     "VOTE (w) + owner INSTALL (2wL) + REPL x2 hops (4wL) + OUTCOME "
+     "(w) candidate records per step", "16*(9*w*l + 2*w)"),
+    ("multihost_sb", "trace",
+     "flight-recorder event scatter: ROUTE (wL) + owner LOCK (2wL) + "
+     "VOTE (w) + owner INSTALL (2wL) + REPL x2 hops (4wL) + OUTCOME "
+     "(w) candidate records per step", "16*(9*w*l + 2*w)"),
 )
 
 

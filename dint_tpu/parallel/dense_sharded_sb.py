@@ -64,6 +64,7 @@ from ..engines.smallbank_pipeline import (L, TS_AMT_MAX, VW, N_STATS,
                                           gen_cohort, _lock_slots)
 from ..engines.types import Op
 from ..monitor import counters as mon
+from ..monitor import txnevents as txe
 from ..monitor import waves
 from ..ops import pallas_gather as pg
 from ..tables import log as logring
@@ -219,7 +220,8 @@ def build_sharded_sb_runner(mesh: Mesh, n_shards: int, n_accounts: int,
                             w: int = 2048, cohorts_per_block: int = 8,
                             hot_frac=None, hot_prob=None, mix=None,
                             use_pallas=None, use_hotset=None,
-                            use_fused=None, monitor: bool = False):
+                            use_fused=None, monitor: bool = False,
+                            trace=None, trace_rate=None, trace_cap=None):
     """jit(shard_map(scan(step))). Contract mirrors the single-chip dense
     runner: (run, init, drain); stats are psummed across the mesh.
 
@@ -249,7 +251,18 @@ def build_sharded_sb_runner(mesh: Mesh, n_shards: int, n_accounts: int,
     overflow counts with the completing cohort's stats. Flow counters
     therefore sum across the device axis to the psummed stats totals.
     Drain returns (state, stats, counters); off (default) = contract and
-    jaxpr unchanged."""
+    jaxpr unchanged.
+
+    ``trace`` / ``trace_rate`` / ``trace_cap``: the dinttrace flight
+    recorder (None = honor DINT_TRACE / DINT_TRACE_RATE); a per-device
+    txnevents.TxnRing carry leaf lands BEFORE the counters leaf. This is
+    the payoff path: the txn id — (gen_step*D + source_dev)*w + lane, the
+    same id on every device — RIDES THE ROUTE (one extra u32 field
+    through the lock all_to_all, one through the install all_to_all, and
+    the ppermute fan-out forwards it to the backups), so source-side
+    ROUTE/VOTE/OUTCOME, owner-side LOCK/INSTALL, and backup-side REPL
+    events of one transaction join by id into a single 2PC span tree.
+    Off = routed fields, jaxpr, and outputs all bit-identical."""
     d = n_shards
     n_loc = n_acct_local(n_accounts, d)
     m1 = m1_local(n_accounts, d)
@@ -280,8 +293,20 @@ def build_sharded_sb_runner(mesh: Mesh, n_shards: int, n_accounts: int,
         kw_gen["hot_frac"] = hot_frac
     if hot_prob is not None:
         kw_gen["hot_prob"] = hot_prob
+    trace_on = txe.trace_enabled(trace)
+    tcfg = None
+    if trace_on:
+        # per-device candidates/step: ROUTE [wL] + owner LOCK [d*cap] +
+        # VOTE [w] + owner INSTALL [d*cap] + REPL x2 [2*d*cap] +
+        # OUTCOME [w]; d*cap = 2*wL rounded up
+        n_step = w * L + 4 * d * cap + 2 * w
+        rcap = int(trace_cap) if trace_cap else n_step * cohorts_per_block
+        tcfg = txe.TraceCfg(rate=txe.trace_rate(trace_rate), cap=rcap,
+                            wave=waves.full_name("dense_sharded_sb",
+                                                 "trace"))
 
-    def local_step(state: SBShard, c1: SBCtx, key, cnt, gen_new=True):
+    def local_step(state: SBShard, c1: SBCtx, key, cnt, ring,
+                   gen_new=True):
         dev = jax.lax.axis_index(AXIS)
         t = state.step
         kgen, kamt = jax.random.split(jax.random.fold_in(key, dev))
@@ -300,6 +325,15 @@ def build_sharded_sb_runner(mesh: Mesh, n_shards: int, n_accounts: int,
         ts_amt = jax.random.randint(kamt, (w,), -TS_AMT_MAX,
                                     TS_AMT_MAX + 1, dtype=I32)
 
+        if ring is not None:
+            # dinttrace ids: one per generated txn, identical on every
+            # device that touches it (the routed copies below carry it)
+            tu = jnp.asarray(t).astype(U32)
+            du = dev.astype(U32)
+            lane_w = jnp.arange(w, dtype=U32)
+            txn_new = (tu * U32(d) + du) * U32(w) + lane_w
+            txn_c1 = ((tu - U32(1)) * U32(d) + du) * U32(w) + lane_w
+
         with waves.scope("dense_sharded_sb", "route"):
             active = (l_op != 0).reshape(-1)
             dest = (l_ac.reshape(-1) % d).astype(I32)
@@ -308,10 +342,13 @@ def build_sharded_sb_runner(mesh: Mesh, n_shards: int, n_accounts: int,
             pos = _positions(dest, active, d)
             valid = active & (pos < cap)
 
-            r_op, r_row = _route(dest, pos, valid, cap, d,
-                                 [l_op.reshape(-1), row_loc])
-            r_op = _a2a(r_op, d, cap)
-            r_row = _a2a(r_row, d, cap)
+            fields = [l_op.reshape(-1), row_loc]
+            if ring is not None:
+                fields.append(jnp.repeat(txn_new, L))
+            routed = [_a2a(x, d, cap)
+                      for x in _route(dest, pos, valid, cap, d, fields)]
+            r_op, r_row = routed[:2]
+            r_txn = routed[2] if ring is not None else None
 
         # ---- owner side: no-wait S/X arbitration + fused read ---------
         lanes = jnp.arange(d * cap, dtype=I32)
@@ -429,13 +466,14 @@ def build_sharded_sb_runner(mesh: Mesh, n_shards: int, n_accounts: int,
                     + c1.acc.reshape(-1) // d).astype(I32)
             wpos = _positions(wdest, wmask, d)
             wvalid = wmask & (wpos < cap)   # no overflow: writes <= locks
-            i_m, i_row, i_bal, i_tbl, i_acc = _route(
-                wdest, wpos, wvalid, cap, d,
-                [wmask.astype(I32), wrow, c1.nw.reshape(-1),
-                 c1.tbl.reshape(-1), c1.acc.reshape(-1)])
+            ifields = [wmask.astype(I32), wrow, c1.nw.reshape(-1),
+                       c1.tbl.reshape(-1), c1.acc.reshape(-1)]
+            if ring is not None:
+                ifields.append(jnp.repeat(txn_c1, L))
             inst = [_a2a(x, d, cap)
-                    for x in (i_m, i_row, i_bal, i_tbl, i_acc)]
-            i_m, i_row, i_bal, i_tbl, i_acc = inst
+                    for x in _route(wdest, wpos, wvalid, cap, d, ifields)]
+            i_m, i_row, i_bal, i_tbl, i_acc = inst[:5]
+            i_txn = inst[5] if ring is not None else None
             i_mask = i_m != 0
 
             irows = jnp.where(i_mask, i_row, oob)
@@ -519,6 +557,7 @@ def build_sharded_sb_runner(mesh: Mesh, n_shards: int, n_accounts: int,
         # CommitBck x2 + CommitLog at the backups: forward applied installs
         with waves.scope("dense_sharded_sb", "replicate"):
             bck = state.bck_bal
+            repl_groups = []
             for off in (1, 2):
                 perm = [(i, (i + off) % d) for i in range(d)]
                 pp = functools.partial(jax.lax.ppermute, axis_name=AXIS,
@@ -529,6 +568,13 @@ def build_sharded_sb_runner(mesh: Mesh, n_shards: int, n_accounts: int,
                     hop = (mon.CTR_REPL_PUSH_HOP1 if off == 1
                            else mon.CTR_REPL_PUSH_HOP2)
                     cnt = mon.bump(cnt, {hop: fwd_mask.sum(dtype=I32)})
+                if ring is not None:
+                    # the forwarded txn id makes the backup-side event
+                    # joinable: same id, shard = the APPLYING device
+                    repl_groups.append(txe.ev(
+                        fwd_mask, pp(i_txn), txe.EV_REPL,
+                        waves.full_name("dense_sharded_sb", "replicate"),
+                        shard=dev, aux=off, step=t.astype(U32)))
                 log, bck = mk_entry(fwd_mask, pp(i_row), pp(i_bal),
                                     pp(i_tbl), pp(i_acc), log, bck,
                                     off - 1, (dev - off) % d)
@@ -581,15 +627,58 @@ def build_sharded_sb_runner(mesh: Mesh, n_shards: int, n_accounts: int,
             })
             cnt = mon.gauge_max(cnt, {mon.CTR_RING_HWM: log.head.max()})
 
+        if ring is not None:
+            # dinttrace: each event lands on exactly ONE device — ROUTE/
+            # VOTE/OUTCOME at the source (this cohort classifies here this
+            # step), LOCK/INSTALL at the owner, REPL at the applying
+            # backup — mirroring the counter attribution above, so the
+            # device-axis event sum reconciles with the summed ledger.
+            with waves.scope("dense_sharded_sb", "trace"):
+                req = r_op != 0
+                grant_l = grant_x | grant_s
+                held_l = held_x | held_s
+                lock_aux = (jnp.where(grant_l, txe.LOCK_GRANTED, 0)
+                            | jnp.where(held_l, txe.LOCK_HELD, 0))
+                ab_lock_m = lock_rejected & (l_op[:, 0] != 0)
+                out_mask = committed | ab_lock_m | logic_abort
+                cause = jnp.where(
+                    ab_lock_m, txe.CAUSE_LOCK,
+                    jnp.where(logic_abort, txe.CAUSE_LOGIC,
+                              txe.CAUSE_COMMIT))
+                groups = (
+                    txe.ev(valid, jnp.repeat(txn_new, L), txe.EV_ROUTE,
+                           waves.full_name("dense_sharded_sb", "route"),
+                           shard=dev, aux=dest, step=tu),
+                    txe.ev(req, r_txn, txe.EV_LOCK,
+                           waves.full_name("dense_sharded_sb",
+                                           "arbitrate"),
+                           shard=dev, aux=lock_aux, step=tu),
+                    txe.ev(l_op[:, 0] != 0, txn_new, txe.EV_VOTE,
+                           waves.full_name("dense_sharded_sb", "reply"),
+                           shard=dev, aux=commit, step=tu),
+                    txe.ev(i_mask, i_txn, txe.EV_INSTALL,
+                           waves.full_name("dense_sharded_sb",
+                                           "install_route"),
+                           shard=dev, step=tu),
+                ) + tuple(repl_groups) + (
+                    txe.ev(out_mask, txn_new, txe.EV_OUTCOME,
+                           waves.full_name("dense_sharded_sb", "reply"),
+                           shard=dev, aux=cause, step=tu),
+                )
+                ring, cnt = txe.emit(ring, tcfg, groups, cnt)
+
         new_ctx = jax.tree.map(lambda x: pcast_varying(x, AXIS), new_ctx)
-        return state, new_ctx, jax.lax.psum(_stats_of(c1), AXIS), cnt
+        return (state, new_ctx, jax.lax.psum(_stats_of(c1), AXIS), cnt,
+                ring)
 
     def scan_fn(carry, key, gen_new=True):
         state, c1 = carry[:2]
-        cnt = carry[2] if monitor else None
-        state, new_ctx, stats, cnt = local_step(state, c1, key, cnt,
-                                                gen_new)
-        out = (state, new_ctx) + ((cnt,) if monitor else ())
+        ring = carry[2] if trace_on else None
+        cnt = carry[-1] if monitor else None
+        state, new_ctx, stats, cnt, ring = local_step(state, c1, key, cnt,
+                                                      ring, gen_new)
+        out = ((state, new_ctx) + ((ring,) if trace_on else ())
+               + ((cnt,) if monitor else ()))
         return out, stats
 
     def sq(tree):
@@ -598,27 +687,36 @@ def build_sharded_sb_runner(mesh: Mesh, n_shards: int, n_accounts: int,
     def unsq(tree):
         return jax.tree.map(lambda x: x[None], tree)
 
+    def _reset_ring(carry):
+        if trace_on:    # each drained window is self-contained
+            carry = carry[:2] + (txe.reset(carry[2]),) + carry[3:]
+        return carry
+
     def block_local(*args):
         key = args[-1]
         keys = jax.random.split(key, cohorts_per_block)
         carry, stats = jax.lax.scan(
-            scan_fn, tuple(sq(a) for a in args[:-1]), keys)
+            scan_fn, _reset_ring(tuple(sq(a) for a in args[:-1])), keys)
         return tuple(unsq(x) for x in carry) + (stats,)
 
     def drain_local(*args):
         key = args[-1]
-        carry, s1 = scan_fn(tuple(sq(a) for a in args[:-1]), key,
-                            gen_new=False)
-        out = (unsq(carry[0]),) + ((unsq(carry[2]),) if monitor else ())
+        carry, s1 = scan_fn(_reset_ring(tuple(sq(a) for a in args[:-1])),
+                            key, gen_new=False)
+        out = (unsq(carry[0]),)
+        if trace_on:
+            out = out + (unsq(carry[2]),)
+        if monitor:
+            out = out + (unsq(carry[-1]),)
         return out + (jnp.stack([s1]),)
 
-    n_carry = 3 if monitor else 2
+    n_carry = 2 + int(trace_on) + int(monitor)
     spec = (P(AXIS),) * n_carry + (P(),)
     block = jax.shard_map(block_local, mesh=mesh, in_specs=spec,
                           out_specs=(P(AXIS),) * n_carry + (P(),))
     drain_m = jax.shard_map(
         drain_local, mesh=mesh, in_specs=spec,
-        out_specs=(P(AXIS),) * (2 if monitor else 1) + (P(),))
+        out_specs=(P(AXIS),) * (n_carry - 1) + (P(),))
     donate = tuple(range(n_carry))
     jit_block = jax.jit(block, donate_argnums=donate)
     jit_drain = jax.jit(drain_m, donate_argnums=donate)
@@ -637,12 +735,20 @@ def build_sharded_sb_runner(mesh: Mesh, n_shards: int, n_accounts: int,
         if use_hotset and state.hot_loc == 0:
             state = attach_hotset_sb(mesh, state, hot_loc)
         base = (state, stack_leaf(_empty_sb_ctx(w)))
-        return base + ((stack_leaf(mon.create()),) if monitor else ())
+        return (base
+                + ((stack_leaf(txe.create_ring(tcfg.cap)),)
+                   if trace_on else ())
+                + ((stack_leaf(mon.create()),) if monitor else ()))
+
+    init.trace_cfg = tcfg
 
     def drain(carry):
         out = jit_drain(*carry, jax.random.PRNGKey(0))
-        if monitor:
-            return out[0], out[2], out[1]
-        return out
+        i = 1
+        ring = out[i] if trace_on else None
+        i += int(trace_on)
+        cnt = out[i] if monitor else None
+        return ((out[0], out[-1]) + ((ring,) if trace_on else ())
+                + ((cnt,) if monitor else ()))
 
     return run, init, drain

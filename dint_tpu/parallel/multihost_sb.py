@@ -58,6 +58,7 @@ from ..engines.smallbank_pipeline import (L, TS_AMT_MAX, VW,
                                           _lock_slots)
 from ..engines.types import Op
 from ..monitor import counters as mon
+from ..monitor import txnevents as txe
 from ..monitor import waves
 from ..tables import log as logring
 from .dense_sharded_sb import (N_BCK, SBCtx, SBShard, _empty_sb_ctx,
@@ -112,7 +113,8 @@ def build_multihost_sb_runner(mesh: Mesh, n_accounts: int, w: int = 2048,
                               cohorts_per_block: int = 8, hot_frac=None,
                               hot_prob=None, mix=None,
                               hierarchical: bool = False,
-                              monitor: bool = False):
+                              monitor: bool = False, trace=None,
+                              trace_rate=None, trace_cap=None):
     """jit(shard_map(scan(step))) over the 2-D mesh. Contract mirrors
     build_sharded_sb_runner: (run, init, drain); stats psummed ici then
     dcn. ``hierarchical`` picks the two-stage (ici, dcn) exchange or the
@@ -122,7 +124,17 @@ def build_multihost_sb_runner(mesh: Mesh, n_accounts: int, w: int = 2048,
     bytes at every calibrated geometry (enforced by hier-dcn-dominance)
     but costs ~3.4% on the virtual mesh where both axes are the same
     fabric, so it stays OPT-IN until a dcn-bearing hardware A/B
-    (tools/hw_multihost.sh) lands."""
+    (tools/hw_multihost.sh) lands.
+
+    ``trace`` / ``trace_rate`` / ``trace_cap``: the dinttrace flight
+    recorder, dsb convention (per-device TxnRing carry leaf before the
+    counters leaf; the txn id rides the lock/install exchanges and the
+    dcn ppermute fan-out, so one transaction's ROUTE -> owner LOCK ->
+    VOTE -> INSTALL -> hop-1/hop-2 REPL events join across hosts). ROUTE
+    events additionally carry the txnevents.ROUTE_DCN aux bit when the
+    owner lives on another host — the hop that pays DCN bytes is visible
+    per transaction, not just in the route_*_lanes totals. Off = routed
+    fields, jaxpr, and outputs all bit-identical."""
     n_hosts, n_ici = mesh.devices.shape
     if n_hosts < 3:
         raise ValueError("multihost replication needs >= 3 hosts "
@@ -139,6 +151,16 @@ def build_multihost_sb_runner(mesh: Mesh, n_accounts: int, w: int = 2048,
         kw_gen["hot_frac"] = hot_frac
     if hot_prob is not None:
         kw_gen["hot_prob"] = hot_prob
+    trace_on = txe.trace_enabled(trace)
+    tcfg = None
+    if trace_on:
+        # per-device candidates/step: same census as the 1-D runner —
+        # ROUTE [wL] + LOCK [d*cap] + VOTE [w] + INSTALL [d*cap] +
+        # REPL x2 [2*d*cap] + OUTCOME [w]
+        n_step = w * L + 4 * d * cap + 2 * w
+        rcap = int(trace_cap) if trace_cap else n_step * cohorts_per_block
+        tcfg = txe.TraceCfg(rate=txe.trace_rate(trace_rate), cap=rcap,
+                            wave=waves.full_name("multihost_sb", "trace"))
 
     def _exchange(x):
         """[D*cap] bucket exchange. Hierarchical: ICI a2a inside each
@@ -154,7 +176,8 @@ def build_multihost_sb_runner(mesh: Mesh, n_accounts: int, w: int = 2048,
                                   (DCN_AXIS, ICI_AXIS), 0, 0,
                                   tiled=False).reshape(d * cap)
 
-    def local_step(state: SBShard, c1: SBCtx, key, cnt, gen_new=True):
+    def local_step(state: SBShard, c1: SBCtx, key, cnt, ring,
+                   gen_new=True):
         h = jax.lax.axis_index(DCN_AXIS)
         c = jax.lax.axis_index(ICI_AXIS)
         dev = h * n_ici + c             # global shard id, dcn-major
@@ -175,6 +198,15 @@ def build_multihost_sb_runner(mesh: Mesh, n_accounts: int, w: int = 2048,
         ts_amt = jax.random.randint(kamt, (w,), -TS_AMT_MAX,
                                     TS_AMT_MAX + 1, dtype=I32)
 
+        if ring is not None:
+            # dinttrace ids: one per generated txn, identical on every
+            # device/host that touches it (routed copies below carry it)
+            tu = jnp.asarray(t).astype(U32)
+            du = dev.astype(U32)
+            lane_w = jnp.arange(w, dtype=U32)
+            txn_new = (tu * U32(d) + du) * U32(w) + lane_w
+            txn_c1 = ((tu - U32(1)) * U32(d) + du) * U32(w) + lane_w
+
         with waves.scope("multihost_sb", "route"):
             active = (l_op != 0).reshape(-1)
             dest = (l_ac.reshape(-1) % d).astype(I32)
@@ -183,10 +215,13 @@ def build_multihost_sb_runner(mesh: Mesh, n_accounts: int, w: int = 2048,
             pos = _positions(dest, active, d)
             valid = active & (pos < cap)
 
-            r_op, r_row = _route(dest, pos, valid, cap, d,
-                                 [l_op.reshape(-1), row_loc])
-            r_op = _exchange(r_op)
-            r_row = _exchange(r_row)
+            fields = [l_op.reshape(-1), row_loc]
+            if ring is not None:
+                fields.append(jnp.repeat(txn_new, L))
+            routed = [_exchange(x)
+                      for x in _route(dest, pos, valid, cap, d, fields)]
+            r_op, r_row = routed[:2]
+            r_txn = routed[2] if ring is not None else None
 
         # ---- owner side: no-wait S/X arbitration + fused read ---------
         lanes = jnp.arange(d * cap, dtype=I32)
@@ -248,13 +283,14 @@ def build_multihost_sb_runner(mesh: Mesh, n_accounts: int, w: int = 2048,
                     + c1.acc.reshape(-1) // d).astype(I32)
             wpos = _positions(wdest, wmask, d)
             wvalid = wmask & (wpos < cap)   # no overflow: writes <= locks
-            i_m, i_row, i_bal, i_tbl, i_acc = _route(
-                wdest, wpos, wvalid, cap, d,
-                [wmask.astype(I32), wrow, c1.nw.reshape(-1),
-                 c1.tbl.reshape(-1), c1.acc.reshape(-1)])
+            ifields = [wmask.astype(I32), wrow, c1.nw.reshape(-1),
+                       c1.tbl.reshape(-1), c1.acc.reshape(-1)]
+            if ring is not None:
+                ifields.append(jnp.repeat(txn_c1, L))
             inst = [_exchange(x)
-                    for x in (i_m, i_row, i_bal, i_tbl, i_acc)]
-            i_m, i_row, i_bal, i_tbl, i_acc = inst
+                    for x in _route(wdest, wpos, wvalid, cap, d, ifields)]
+            i_m, i_row, i_bal, i_tbl, i_acc = inst[:5]
+            i_txn = inst[5] if ring is not None else None
             i_mask = i_m != 0
 
             irows = jnp.where(i_mask, i_row, oob)
@@ -294,6 +330,7 @@ def build_multihost_sb_runner(mesh: Mesh, n_accounts: int, w: int = 2048,
         # replicas of every row live on 3 different hosts
         with waves.scope("multihost_sb", "replicate"):
             bck = state.bck_bal
+            repl_groups = []
             for off in (1, 2):
                 perm = [(i, (i + off) % n_hosts) for i in range(n_hosts)]
                 pp = functools.partial(jax.lax.ppermute,
@@ -303,6 +340,13 @@ def build_multihost_sb_runner(mesh: Mesh, n_accounts: int, w: int = 2048,
                     hop = (mon.CTR_REPL_PUSH_HOP1 if off == 1
                            else mon.CTR_REPL_PUSH_HOP2)
                     cnt = mon.bump(cnt, {hop: fwd_mask.sum(dtype=I32)})
+                if ring is not None:
+                    # the forwarded txn id makes the backup-side event
+                    # joinable: same id, shard = the APPLYING device
+                    repl_groups.append(txe.ev(
+                        fwd_mask, pp(i_txn), txe.EV_REPL,
+                        waves.full_name("multihost_sb", "replicate"),
+                        shard=dev, aux=off, step=t.astype(U32)))
                 src_dev = ((h - off) % n_hosts) * n_ici + c
                 log, bck = mk_entry(fwd_mask, pp(i_row), pp(i_bal),
                                     pp(i_tbl), pp(i_acc), log, bck,
@@ -349,18 +393,60 @@ def build_multihost_sb_runner(mesh: Mesh, n_accounts: int, w: int = 2048,
             })
             cnt = mon.gauge_max(cnt, {mon.CTR_RING_HWM: log.head.max()})
 
+        if ring is not None:
+            # dinttrace (dsb attribution: source emits ROUTE/VOTE/OUTCOME,
+            # owner emits LOCK/INSTALL, applying backup emits REPL); the
+            # ROUTE aux carries dest | ROUTE_DCN when the owner lives on
+            # another host — the per-txn twin of route_dcn_lanes
+            with waves.scope("multihost_sb", "trace"):
+                req = r_op != 0
+                grant_l = grant_x | grant_s
+                held_l = held_x | held_s
+                lock_aux = (jnp.where(grant_l, txe.LOCK_GRANTED, 0)
+                            | jnp.where(held_l, txe.LOCK_HELD, 0))
+                ab_lock_m = lock_rejected & (l_op[:, 0] != 0)
+                out_mask = committed | ab_lock_m | logic_abort
+                cause = jnp.where(
+                    ab_lock_m, txe.CAUSE_LOCK,
+                    jnp.where(logic_abort, txe.CAUSE_LOGIC,
+                              txe.CAUSE_COMMIT))
+                route_aux = dest | jnp.where(dest // n_ici != h,
+                                             txe.ROUTE_DCN, 0)
+                groups = (
+                    txe.ev(valid, jnp.repeat(txn_new, L), txe.EV_ROUTE,
+                           waves.full_name("multihost_sb", "route"),
+                           shard=dev, aux=route_aux, step=tu),
+                    txe.ev(req, r_txn, txe.EV_LOCK,
+                           waves.full_name("multihost_sb", "arbitrate"),
+                           shard=dev, aux=lock_aux, step=tu),
+                    txe.ev(l_op[:, 0] != 0, txn_new, txe.EV_VOTE,
+                           waves.full_name("multihost_sb", "reply"),
+                           shard=dev, aux=commit, step=tu),
+                    txe.ev(i_mask, i_txn, txe.EV_INSTALL,
+                           waves.full_name("multihost_sb",
+                                           "install_route"),
+                           shard=dev, step=tu),
+                ) + tuple(repl_groups) + (
+                    txe.ev(out_mask, txn_new, txe.EV_OUTCOME,
+                           waves.full_name("multihost_sb", "reply"),
+                           shard=dev, aux=cause, step=tu),
+                )
+                ring, cnt = txe.emit(ring, tcfg, groups, cnt)
+
         new_ctx = jax.tree.map(
             lambda x: pcast_varying(x, DCN_AXIS, ICI_AXIS), new_ctx)
         stats = jax.lax.psum(
             jax.lax.psum(_stats_of(c1), ICI_AXIS), DCN_AXIS)
-        return state, new_ctx, stats, cnt
+        return state, new_ctx, stats, cnt, ring
 
     def scan_fn(carry, key, gen_new=True):
         state, c1 = carry[:2]
-        cnt = carry[2] if monitor else None
-        state, new_ctx, stats, cnt = local_step(state, c1, key, cnt,
-                                                gen_new)
-        out = (state, new_ctx) + ((cnt,) if monitor else ())
+        ring = carry[2] if trace_on else None
+        cnt = carry[-1] if monitor else None
+        state, new_ctx, stats, cnt, ring = local_step(state, c1, key, cnt,
+                                                      ring, gen_new)
+        out = ((state, new_ctx) + ((ring,) if trace_on else ())
+               + ((cnt,) if monitor else ()))
         return out, stats
 
     def sq(tree):
@@ -369,28 +455,37 @@ def build_multihost_sb_runner(mesh: Mesh, n_accounts: int, w: int = 2048,
     def unsq(tree):
         return jax.tree.map(lambda x: x[None, None], tree)
 
+    def _reset_ring(carry):
+        if trace_on:    # each drained window is self-contained
+            carry = carry[:2] + (txe.reset(carry[2]),) + carry[3:]
+        return carry
+
     def block_local(*args):
         key = args[-1]
         keys = jax.random.split(key, cohorts_per_block)
         carry, stats = jax.lax.scan(
-            scan_fn, tuple(sq(a) for a in args[:-1]), keys)
+            scan_fn, _reset_ring(tuple(sq(a) for a in args[:-1])), keys)
         return tuple(unsq(x) for x in carry) + (stats,)
 
     def drain_local(*args):
         key = args[-1]
-        carry, s1 = scan_fn(tuple(sq(a) for a in args[:-1]), key,
-                            gen_new=False)
-        out = (unsq(carry[0]),) + ((unsq(carry[2]),) if monitor else ())
+        carry, s1 = scan_fn(_reset_ring(tuple(sq(a) for a in args[:-1])),
+                            key, gen_new=False)
+        out = (unsq(carry[0]),)
+        if trace_on:
+            out = out + (unsq(carry[2]),)
+        if monitor:
+            out = out + (unsq(carry[-1]),)
         return out + (jnp.stack([s1]),)
 
     grid = P(DCN_AXIS, ICI_AXIS)
-    n_carry = 3 if monitor else 2
+    n_carry = 2 + int(trace_on) + int(monitor)
     spec = (grid,) * n_carry + (P(),)
     block = jax.shard_map(block_local, mesh=mesh, in_specs=spec,
                           out_specs=(grid,) * n_carry + (P(),))
     drain_m = jax.shard_map(
         drain_local, mesh=mesh, in_specs=spec,
-        out_specs=(grid,) * (2 if monitor else 1) + (P(),))
+        out_specs=(grid,) * (n_carry - 1) + (P(),))
     donate = tuple(range(n_carry))
     jit_block = jax.jit(block, donate_argnums=donate)
     jit_drain = jax.jit(drain_m, donate_argnums=donate)
@@ -409,12 +504,20 @@ def build_multihost_sb_runner(mesh: Mesh, n_accounts: int, w: int = 2048,
 
     def init(state):
         base = (state, stack_leaf(_empty_sb_ctx(w)))
-        return base + ((stack_leaf(mon.create()),) if monitor else ())
+        return (base
+                + ((stack_leaf(txe.create_ring(tcfg.cap)),)
+                   if trace_on else ())
+                + ((stack_leaf(mon.create()),) if monitor else ()))
+
+    init.trace_cfg = tcfg
 
     def drain(carry):
         out = jit_drain(*carry, jax.random.PRNGKey(0))
-        if monitor:
-            return out[0], out[2], out[1]
-        return out
+        i = 1
+        ring = out[i] if trace_on else None
+        i += int(trace_on)
+        cnt = out[i] if monitor else None
+        return ((out[0], out[-1]) + ((ring,) if trace_on else ())
+                + ((cnt,) if monitor else ()))
 
     return run, init, drain

@@ -66,15 +66,52 @@ def _monitor_on() -> bool:
     return os.environ.get("DINT_MONITOR") == "1"
 
 
+def _trace_on() -> bool:
+    """DINT_TRACE=1 threads the dinttrace flight-recorder ring through
+    every pipeline sweep point; each closed point's artifact then embeds
+    the event summary (explicit null otherwise — OBSERVABILITY.md).
+    DINT_TRACE_RATE tunes the sampling mask; the full JSONL stream is a
+    bench.py feature (DINT_TRACE_JSONL), not a sweep one."""
+    return os.environ.get("DINT_TRACE") == "1"
+
+
 def _drain(drain, carry):
-    """Drain a runner, tolerating both contracts: (state, stats) and the
-    monitored (state, stats, counters). Returns (tail_stats, snapshot)."""
+    """Drain a runner under the current flags. Runners return
+    (state, stats) + ((ring,) if DINT_TRACE) + ((counters,) if
+    DINT_MONITOR) — flag-aware unpacking, NOT length heuristics (a
+    traced-but-unmonitored drain is also length 3). Returns
+    (tail_stats, counter_snapshot_or_None, ring_or_None)."""
     out = drain(carry)
-    if len(out) == 3:
+    tail, rest = out[1], list(out[2:])
+    ring = rest.pop(0) if _trace_on() and rest else None
+    counters = None
+    if _monitor_on() and rest:
         from dint_tpu import monitor as dm
 
-        return out[1], dm.snapshot(out[2])
-    return out[1], None
+        counters = dm.snapshot(rest.pop(0))
+    return tail, counters, ring
+
+
+def _wrap_trace(run, init):
+    """DINT_TRACE=1: wrap a runner so each block's event ring is drained
+    into a per-point TxnMonitor (the ring zeroes at block entry, so the
+    observe must ride every dispatch; defer=True double-buffers the
+    fetch). The monitor hangs off the returned fn as ``txn_monitor`` for
+    the closed-loop window to summarize."""
+    if not _trace_on() or getattr(init, "trace_cfg", None) is None:
+        return run
+    from dint_tpu.monitor import txnevents as txe
+
+    tmon = txe.TxnMonitor(init.trace_cfg)
+    ring_ix = -2 if _monitor_on() else -1
+
+    def traced(carry, key, _run=run, _ix=ring_ix):
+        carry, stats = _run(carry, key)
+        tmon.observe(carry[_ix], defer=True)
+        return carry, stats
+
+    traced.txn_monitor = tmon
+    return traced
 
 
 def pipeline_closed(run, carry, drain, n_stats, *, window_s, cpb,
@@ -105,12 +142,19 @@ def pipeline_closed(run, carry, drain, n_stats, *, window_s, cpb,
         carry, total, warm, dt, _blocks, block_s = st.run_window(
             run, carry, key, window_s, n_stats, warmup_blocks=0)
     cores = cpu.cores()
-    tail, counters = _drain(drain, carry)
+    tail, counters, ring = _drain(drain, carry)
     total = total + np.asarray(tail, np.int64).sum(axis=0)
     if int(s0[magic_idx] + warm[magic_idx] + total[magic_idx]) != 0:
         raise RuntimeError("magic-byte integrity violated (incl. warmup)")
     p = st.cohort_latency_percentiles(block_s, cpb, depth)
-    return total, dt, p, cores, counters
+    trace_sum = None
+    tmon = getattr(run, "txn_monitor", None)
+    if tmon is not None:
+        tmon.flush()
+        if ring is not None:    # the drained boundary cohorts' events
+            tmon.observe(ring)
+        trace_sum = tmon.summary()
+    return total, dt, p, cores, counters, trace_sum
 
 
 def pipeline_open(make_runner, n_stats, *, rate, window_s, w, cpb, depth,
@@ -164,7 +208,7 @@ def pipeline_open(make_runner, n_stats, *, rate, window_s, w, cpb, depth,
         service_lat.add((done - t_disp) * 1e6)
         i += 1
     dt = time.time() - t0
-    tail, _ = _drain(drain, carry)
+    tail, _, _ = _drain(drain, carry)
     total += np.asarray(tail, np.int64).sum(axis=0)
     p = _percentiles(lat_blocks)
     offered = i * cpb * w / dt
@@ -197,7 +241,8 @@ def _tatp_runner(n_sub, w, cpb, seed=0):
                                 val_words=10)
         run, init, drain = td.build_pipelined_runner(
             n_sub, w=w, val_words=10, cohorts_per_block=cpb, use_pallas=up,
-            monitor=_monitor_on())
+            monitor=_monitor_on(), trace=_trace_on())
+        run = _wrap_trace(run, init)
         carry = init(db)
         if up:
             # force the full-geometry compile NOW: a Mosaic failure the
@@ -244,7 +289,8 @@ def _sb_runner(n_acc, w, cpb, hot_frac=None, hot_prob=None):
         run, init, drain = sd.build_pipelined_runner(
             n_acc, w=w, cohorts_per_block=cpb, use_pallas=up,
             hot_frac=hot_frac, hot_prob=hot_prob,
-            monitor=_monitor_on())
+            monitor=_monitor_on(), trace=_trace_on())
+        run = _wrap_trace(run, init)
         carry = init(db)
         if up:
             # same full-geometry degrade rule as _tatp_runner
@@ -283,7 +329,9 @@ def _mh_sb_runner(n_acc, w, cpb, hierarchical):
     mesh = mh.make_mesh_2d(n_hosts, n_ici)
     run, init, drain = mh.build_multihost_sb_runner(
         mesh, n_acc, w=w, cohorts_per_block=cpb,
-        hierarchical=hierarchical, monitor=_monitor_on())
+        hierarchical=hierarchical, monitor=_monitor_on(),
+        trace=_trace_on())
+    run = _wrap_trace(run, init)
     return run, init(mh.create_multihost_sb(mesh, n_acc)), drain
 
 
@@ -371,7 +419,7 @@ def sweep_pipeline(name, runner_fn, extras_fn, n_stats, *, widths, cpb,
     def closed_point(w):
         def fn():
             run, carry, drain = runner_fn(w, cpb)
-            total, dt, p, cores, counters = pipeline_closed(
+            total, dt, p, cores, counters, trace_sum = pipeline_closed(
                 run, carry, drain, n_stats, window_s=window_s, cpb=cpb,
                 depth=depth, magic_idx=magic_idx)
             att, com, extra = extras_fn(total)
@@ -381,6 +429,8 @@ def sweep_pipeline(name, runner_fn, extras_fn, n_stats, *, widths, cpb,
             extra.update(point_extra or {})
             # end-of-point dintmon snapshot; explicit null when off
             extra["counters"] = counters
+            # dinttrace flight-recorder summary; same null contract
+            extra["dinttrace"] = trace_sum
             return _metric_json(att, com, dt, p, extra,
                                 breakdown=_breakdown(w))
 
@@ -433,7 +483,7 @@ def sweep_pipeline(name, runner_fn, extras_fn, n_stats, *, widths, cpb,
             carry, total, dt, steps, p = st.run_latency_window(
                 run, carry, jax.random.PRNGKey(7), window_s, n_stats,
                 depth=depth)
-            tail, _ = _drain(drain, carry)
+            tail, _, _ = _drain(drain, carry)
             total = total + np.asarray(tail, np.int64).sum(axis=0)
             att, com, extra = extras_fn(total)
             extra.update(mode="latency_measured", width=w, cpb=1,
@@ -721,8 +771,11 @@ def _tatp_wire_bench(window_s, quick):
     wave = width // n_clients
     n_lock = wave // 10
 
-    shard = tc.populate_shards(np.random.default_rng(0), n_sub,
-                               val_words=10)[0][0]
+    # quick mode scales the recovery-log ring down with everything else:
+    # the full 1<<20 window is a ~1 GB zero-fill before the first packet
+    shard = tc.populate_shards(np.random.default_rng(0), n_sub, val_words=10,
+                               log_capacity=1 << 14 if quick else 1 << 20,
+                               )[0][0]
 
     with EnginePump(TATP, tatp.step, shard, width=width,
                     flush_us=500).start() as pump:

@@ -329,7 +329,7 @@ def test_parity_counters_generic_vs_dense():
     carry_d = init_d(db)
 
     shards, _ = tc.populate_shards(np.random.default_rng(seed), N_SUB,
-                                   val_words=VW)
+                                   val_words=VW, log_capacity=1 << 14)
     run_g, init_g, drain_g = _tp_build(True)
     carry_g = init_g(tp.stack_shards(shards))
 

@@ -91,7 +91,8 @@ def test_tatp_integrated_attribution(rng):
 
     n_sub = 24
     shards, _ = tc.populate_shards(rng, n_sub, val_words=4,
-                                   cf_lock_slots=16, attr_locks=True)
+                                   cf_lock_slots=16, attr_locks=True,
+                                   log_capacity=1 << 14)
     assert isinstance(shards[0].cf_lock, locks.OCCAttrTable)
     coord = tc.Coordinator(shards, n_sub, width=2048, val_words=4)
     for _ in range(6):
@@ -114,7 +115,8 @@ def test_tatp_integrated_attribution(rng):
 def test_tatp_attr_off_by_default(rng):
     from dint_tpu.clients import tatp_client as tc
 
-    shards, _ = tc.populate_shards(rng, 8, val_words=4)
+    shards, _ = tc.populate_shards(rng, 8, val_words=4,
+                                   log_capacity=1 << 14)
     assert not isinstance(shards[0].cf_lock, locks.OCCAttrTable)
 
 
@@ -123,7 +125,8 @@ def test_tatp_attr_counters_stay_zero_without_attr_shards(rng):
     every CF reject as 'sharing'."""
     from dint_tpu.clients import tatp_client as tc
 
-    shards, _ = tc.populate_shards(rng, 24, val_words=4)
+    shards, _ = tc.populate_shards(rng, 24, val_words=4,
+                                   log_capacity=1 << 14)
     coord = tc.Coordinator(shards, 24, width=2048, val_words=4)
     for _ in range(3):
         coord.run_cohort(rng, 256)

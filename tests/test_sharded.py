@@ -14,7 +14,8 @@ def test_replicated_step_8dev(rng):
     mesh = sharded.make_mesh(n)
     p = 64  # global subscribers
     state = sharded.create_sharded_state(mesh, n, p, val_words=VW,
-                                         cf_buckets=256, cf_lock_slots=256)
+                                         cf_buckets=256, cf_lock_slots=256,
+                                         log_capacity=1 << 12)
     step = sharded.build_sharded_step(mesh, n)
 
     # lock a set of subscriber rows (primary-routed), then commit them
@@ -90,7 +91,8 @@ def test_sharded_smallbank_8dev(rng):
     n = 8
     mesh = sharded.make_mesh(n)
     n_accounts = 64
-    state = sharded.create_sharded_smallbank(mesh, n, n_accounts, val_words=2)
+    state = sharded.create_sharded_smallbank(mesh, n, n_accounts, val_words=2,
+                                             log_capacity=1 << 12)
     step = sharded.build_sharded_step(mesh, n, engine="smallbank")
 
     accts = rng.choice(np.arange(n_accounts), size=32, replace=False).astype(np.int64)

@@ -194,7 +194,7 @@ def test_tatp_wire_occ_roundtrip(rng):
     from dint_tpu.shim import TATP
 
     shard = tc.populate_shards(np.random.default_rng(0), 64,
-                               val_words=10)[0][0]
+                               val_words=10, log_capacity=1 << 14)[0][0]
     sub = np.array([tatp.SUBSCRIBER], np.uint8)
     k5 = np.array([5], np.uint64)
     with EnginePump(TATP, tatp.step, shard, width=128,

@@ -15,7 +15,7 @@ def _batch(ops, tbls, accts, vals=None, vers=None, width=64):
 
 
 def test_fused_lock_read_and_commit():
-    shard = smallbank.create(100, val_words=VW)
+    shard = smallbank.create(100, val_words=VW, log_capacity=1 << 12)
     vals = np.zeros((100, VW), np.uint32)
     vals[:, 0] = 50
     vals[:, 1] = wl.SB_MAGIC
@@ -56,7 +56,7 @@ def test_fused_lock_read_and_commit():
 
 def test_commit_then_acquire_same_batch():
     # commit installs before acquires read (batch serialization contract)
-    shard = smallbank.create(10, val_words=VW)
+    shard = smallbank.create(10, val_words=VW, log_capacity=1 << 12)
     nv = np.zeros((2, VW), np.uint32)
     nv[0, 0] = 9
     b = _batch([Op.COMMIT_PRIM, Op.ACQ_S_READ],

@@ -11,7 +11,8 @@ P = 200  # subscribers
 
 def _shards(rng):
     return tc.populate_shards(rng, P, val_words=VW,
-                              cf_buckets=1 << 10, cf_lock_slots=1 << 10)
+                              cf_buckets=1 << 10, cf_lock_slots=1 << 10,
+                              log_capacity=1 << 14)
 
 
 def _b(ops, tbls, keys, vals=None, vers=None, width=64):

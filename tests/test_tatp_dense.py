@@ -227,7 +227,7 @@ def test_matches_generic_pipelined_engine_at_low_contention():
     carry = init_d(db)
 
     shards, _ = tc.populate_shards(np.random.default_rng(seed), n_sub,
-                                   val_words=VW)
+                                   val_words=VW, log_capacity=1 << 14)
     stacked = tp.stack_shards(shards)
     run_g, init_g, drain_g = tp.build_pipelined_runner(
         n_sub, w=w, val_words=VW, cohorts_per_block=2)

@@ -17,7 +17,8 @@ from dint_tpu.engines import tatp, tatp_pipeline as tp
 def _stacked0():
     rng = np.random.default_rng(7)
     shards, _ = tc.populate_shards(rng, 64, val_words=4, cf_buckets=1 << 10,
-                                   cf_lock_slots=1 << 10)
+                                   cf_lock_slots=1 << 10,
+                                   log_capacity=1 << 14)
     return tp.stack_shards(shards)
 
 
@@ -74,14 +75,16 @@ def test_abort_rate_matches_host_coordinator():
     n_sub, w, iters = 48, 256, 6
     rng = np.random.default_rng(11)
     shards, _ = tc.populate_shards(rng, n_sub, val_words=4,
-                                   cf_buckets=1 << 10, cf_lock_slots=1 << 10)
+                                   cf_buckets=1 << 10, cf_lock_slots=1 << 10,
+                                   log_capacity=1 << 14)
     coord = tc.Coordinator(shards, n_sub, width=2048, val_words=4)
     for _ in range(iters):
         coord.run_cohort(rng, w)
 
     shards2, _ = tc.populate_shards(np.random.default_rng(11), n_sub,
                                     val_words=4, cf_buckets=1 << 10,
-                                    cf_lock_slots=1 << 10)
+                                    cf_lock_slots=1 << 10,
+                                    log_capacity=1 << 14)
     run = tp.build_runner(n_sub, w=w, val_words=4, cohorts_per_block=iters)
     _, stats = run(tp.stack_shards(shards2), jax.random.PRNGKey(5))
     tot = np.asarray(stats, np.int64).sum(axis=0)

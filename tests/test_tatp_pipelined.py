@@ -13,7 +13,10 @@ def _run(n_sub, w, blocks, cohorts_per_block=2, seed=0, mix=None):
     # cf_buckets left to tatp.create's default sizing (~load<=0.25 at 4
     # slots), which scales with n_sub — a hardcoded 1<<12 cannot hold the
     # ~37.5k CF rows populated at n_sub=20_000
-    shards, _ = tc.populate_shards(rng, n_sub, val_words=VW)
+    # log_capacity: the default 1<<20 ring is a GB-scale zero+copy per
+    # block on the CI host; these runs commit a few thousand rows at most
+    shards, _ = tc.populate_shards(rng, n_sub, val_words=VW,
+                                   log_capacity=1 << 14)
     stacked = tp.stack_shards(shards)
     run, init, drain = tp.build_pipelined_runner(
         n_sub, w=w, val_words=VW, cohorts_per_block=cohorts_per_block,

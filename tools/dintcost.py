@@ -14,6 +14,7 @@ Usage:
     python tools/dintcost.py check --all                 # the CI gate
     python tools/dintcost.py check --target tatp_dense/block@fused
         [--allowlist tools/dintlint_allow.json] [--json]
+    python tools/dintcost.py check --all --sarif out.sarif  # SARIF 2.1.0
     python tools/dintcost.py diff A.json B.json [--bytes-pct 10] [--json]
     python tools/dintcost.py describe [--json]           # budget ledger
 
@@ -173,6 +174,13 @@ def cmd_check(args, ap) -> int:
                             passes=["cost_budget"],
                             allowlist_path=allowlist)
     failed = analysis.has_errors(findings)
+    if args.sarif:
+        sarif = json.dumps(analysis.to_sarif(findings, ap.prog), indent=1)
+        if args.sarif == "-":
+            print(sarif, flush=True)
+        else:
+            with open(args.sarif, "w") as fh:
+                fh.write(sarif + "\n")
     if args.json:
         print(json.dumps({
             "metric": "dintcost", "schema": JSON_SCHEMA, "mode": "check",
@@ -302,6 +310,9 @@ def main(argv=None) -> int:
     p.add_argument("--allowlist", default=None,
                    help="allowlist JSON path (default: "
                         "tools/dintlint_allow.json when present)")
+    p.add_argument("--sarif", metavar="PATH", default=None,
+                   help="also write the findings as SARIF 2.1.0 "
+                        "('-' for stdout) — same exporter dintlint uses")
     p.add_argument("--json", action="store_true")
     p.set_defaults(fn=cmd_check)
 

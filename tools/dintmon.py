@@ -13,7 +13,12 @@ Usage:
     python tools/dintmon.py export-trace RUN.jsonl -o trace.json
     python tools/dintmon.py export-trace RUN.jsonl -o merged.json \
         --merge trace_dir/          # counters + device ops, one timeline
+    python tools/dintmon.py check RUN.jsonl                # ledger identities
     python tools/dintmon.py describe                       # the registry
+
+`check` verifies the counter-plane ledger identities (lock grant/reject
+split, dispatch split, route-lane conservation) on either artifact kind
+and exits 1 naming the violated identity.
 
 `export-trace` writes the Chrome trace-event format — load it in
 chrome://tracing or https://ui.perfetto.dev to see the wave timeline with
@@ -139,6 +144,66 @@ def cmd_export_trace(args) -> int:
     return 0
 
 
+# ledger identities every engine's counter plane must satisfy exactly
+# (OBSERVABILITY.md "Reconciliation"): (name, lhs terms, rhs terms,
+# gate term or None — a gated identity is skipped when every gate
+# counter is zero, e.g. the route split on single-device paths)
+_IDENTITIES = (
+    ("lock_requests == lock_granted + lock_rejected",
+     ("lock_requests",), ("lock_granted", "lock_rejected"), None),
+    ("lock_rejected == lock_reject_held + lock_reject_arb",
+     ("lock_rejected",), ("lock_reject_held", "lock_reject_arb"), None),
+    ("steps == dispatch_xla + dispatch_pallas",
+     ("steps",), ("dispatch_xla", "dispatch_pallas"), None),
+    ("route_ici_lanes + route_dcn_lanes == lock_requests + install_writes",
+     ("route_ici_lanes", "route_dcn_lanes"),
+     ("lock_requests", "install_writes"),
+     ("route_ici_lanes", "route_dcn_lanes")),
+)
+
+
+def cmd_check(args) -> int:
+    s = _load_summary(args.file)
+    c = s.get("counters")
+    if c is None:
+        out = {"path": s["path"], "ok": False,
+               "error": "counters = null (monitoring was off)"}
+        if args.json:
+            print(json.dumps(out), flush=True)
+        else:
+            print(f"{s['path']}: counters = null (monitoring was off) "
+                  "-> nothing to check", file=sys.stderr)
+        return 1
+    rows, ok = [], True
+    for name, lhs, rhs, gate in _IDENTITIES:
+        if gate is not None and not any(c.get(g, 0) for g in gate):
+            rows.append({"identity": name, "status": "skipped",
+                         "lhs": 0, "rhs": 0})
+            continue
+        lv = sum(int(c.get(k, 0)) for k in lhs)
+        rv = sum(int(c.get(k, 0)) for k in rhs)
+        good = lv == rv
+        ok = ok and good
+        rows.append({"identity": name,
+                     "status": "ok" if good else "violated",
+                     "lhs": lv, "rhs": rv})
+    out = {"path": s["path"], "ok": ok, "identities": rows}
+    if args.json:
+        print(json.dumps(out), flush=True)
+    else:
+        print(f"{s['path']} ({s['source']})")
+        for r in rows:
+            mark = {"ok": "ok ", "violated": "FAIL",
+                    "skipped": "--  "}[r["status"]]
+            detail = ("" if r["status"] == "skipped"
+                      else f"  ({r['lhs']:,} vs {r['rhs']:,})")
+            print(f"  {mark} {r['identity']}{detail}")
+        print("dintmon check: " + ("ok" if ok else "FAIL — violated: "
+              + "; ".join(r["identity"] for r in rows
+                          if r["status"] == "violated")))
+    return 0 if ok else 1
+
+
 def cmd_describe(args) -> int:
     if args.json:
         print(json.dumps({
@@ -191,6 +256,12 @@ def main(argv=None) -> int:
                    help="explicit dintmon->profiler clock offset override")
     p.add_argument("--json", action="store_true")
     p.set_defaults(fn=cmd_export_trace)
+
+    p = sub.add_parser("check",
+                       help="verify the ledger identities on one artifact")
+    p.add_argument("file")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_check)
 
     p = sub.add_parser("describe", help="print the counter registry")
     p.add_argument("--json", action="store_true")
