@@ -321,6 +321,33 @@ def _child_main():
         except Exception as e:  # noqa: BLE001
             breakdown_err = repr(e)[:200]
 
+    # dintserve saturation probe (round 17, opt-in): a short open-loop
+    # burst through the serving plane at the bench width records serving
+    # capacity and the queue/service split NEXT TO the closed-loop
+    # headline — the two should agree at occupancy == width, and the gap
+    # is the serving plane's ingestion overhead. Object when
+    # DINT_BENCH_SERVE=1, EXPLICIT null otherwise; a probe failure
+    # records the error, never voids the measurement.
+    serve_out = None
+    if os.environ.get("DINT_BENCH_SERVE") == "1":
+        try:
+            from dint_tpu.serve import ControllerCfg, ServeEngine
+            s_eng = ServeEngine(
+                "tatp_dense", N_SUBSCRIBERS,
+                cfg=ControllerCfg(widths=(WIDTH,)),
+                cohorts_per_block=BLOCK, val_words=VAL_WORDS,
+                monitor=True, runner_kw={"use_pallas": use_pallas})
+            s_eng.warmup()
+            s_eng.run(np.zeros(WIDTH * BLOCK * 8))
+            s_eng.close()
+            rep = s_eng.snapshot()
+            serve_out = {k: rep[k] for k in
+                         ("offered", "admitted", "shed", "blocks",
+                          "achieved_rate", "slo_us", "slo_met",
+                          "queue", "service")}
+        except Exception as e:  # noqa: BLE001
+            serve_out = {"error": repr(e)[:200]}
+
     out = {
         "schema": attrib.ARTIFACT_SCHEMA,
         "metric": "tatp_committed_txns_per_sec",
@@ -378,6 +405,9 @@ def _child_main():
         # stream goes to DINT_TRACE_JSONL for tools/dinttrace.py),
         # EXPLICIT null otherwise
         "dinttrace": trace_out,
+        # dintserve saturation probe (object when DINT_BENCH_SERVE=1,
+        # explicit null otherwise — same consumer contract as counters)
+        "serve": serve_out,
         # dintlint --all --json verdict the round ran under (same
         # object-or-explicit-null contract; filled in below so the gate
         # subprocess runs after the measurement window, not inside it)

@@ -98,6 +98,12 @@ def _key_aval():
     return jax.ShapeDtypeStruct((2,), jnp.uint32)
 
 
+def _occ_aval():
+    # dintserve per-cohort occupancy / shed vectors: one i32 per step of
+    # the block scan (engines' serve=True run signature)
+    return jax.ShapeDtypeStruct((_BLK,), jnp.int32)
+
+
 def _mesh(n: int):
     if len(jax.devices()) < n:
         raise SkipTarget(
@@ -130,7 +136,8 @@ TARGET_FLAT_TWIN: dict[str, str] = {}
 def _tatp_dense(name: str, use_pallas: bool, monitor: bool = False,
                 use_hotset: bool = False,
                 use_fused: bool = False,
-                trace: bool = False) -> TargetTrace:
+                trace: bool = False,
+                serve: bool = False) -> TargetTrace:
     from ..engines import tatp_dense as td
     from .. import monitor as mn
     from ..monitor import txnevents as txe
@@ -139,7 +146,8 @@ def _tatp_dense(name: str, use_pallas: bool, monitor: bool = False,
                                              use_pallas=use_pallas,
                                              use_hotset=use_hotset,
                                              use_fused=use_fused,
-                                             monitor=monitor, trace=trace)
+                                             monitor=monitor, trace=trace,
+                                             serve=serve)
     if use_hotset:
         carry = _abstract(lambda: init(td.create(_N_SUB, val_words=_VW,
                                                  log_capacity=_LOGCAP)))
@@ -150,7 +158,10 @@ def _tatp_dense(name: str, use_pallas: bool, monitor: bool = False,
                      td.empty_ctx(_W), td.empty_ctx(_W))
             + ((txe.create_ring(init.trace_cfg.cap),) if trace else ())
             + ((mn.create(),) if monitor else ()))
-    return trace_target(name, run, (carry, _key_aval()))
+    args = (carry, _key_aval())
+    if serve:
+        args += (_occ_aval(), _occ_aval())
+    return trace_target(name, run, args)
 
 
 @register_target("tatp_dense/block",
@@ -204,19 +215,24 @@ def _t_tatp_dense_drain() -> TargetTrace:
 def _sb_dense(name: str, use_pallas: bool, monitor: bool = False,
               use_hotset: bool = False,
               use_fused: bool = False,
-              trace: bool = False) -> TargetTrace:
+              trace: bool = False,
+              serve: bool = False) -> TargetTrace:
     from ..engines import smallbank_dense as sd
     run, init, _ = sd.build_pipelined_runner(_N_ACCT, w=_W,
                                              cohorts_per_block=_BLK,
                                              use_pallas=use_pallas,
                                              use_hotset=use_hotset,
                                              use_fused=use_fused,
-                                             monitor=monitor, trace=trace)
+                                             monitor=monitor, trace=trace,
+                                             serve=serve)
     # carry via the runner's own init so the @hot variants get the hot
     # mirror attached exactly as production does
     carry = _abstract(lambda: init(sd.create(_N_ACCT,
                                              log_capacity=_LOGCAP)))
-    return trace_target(name, run, (carry, _key_aval()))
+    args = (carry, _key_aval())
+    if serve:
+        args += (_occ_aval(), _occ_aval())
+    return trace_target(name, run, args)
 
 
 @register_target("smallbank_dense/block",
@@ -747,6 +763,49 @@ def _t_multihost_sb_trace() -> TargetTrace:
     return _multihost_sb("multihost_sb/block@trace", 4, 2, trace=True)
 
 
+# --------------------------------------------- dintserve serving plane
+# The serve-mode blocks (round 17): the same dense pipelines with the
+# variable-occupancy mask + serve counter bumps. Registered from day one
+# so every standing gate — purity (dintlint), conservation (dintproof),
+# durability (dintdur, via the family loop below), and the static cost
+# ledger (dintcost rows at the bottom) — prices the serving path exactly
+# like the closed-loop path it masks.
+
+
+@register_target("tatp_dense/serve",
+                 "dense TATP serve-mode block: variable-occupancy mask "
+                 "over the fused 3-wave pipeline (dintserve steady state)",
+                 protocol=('certified', 'occ'))
+def _t_tatp_dense_serve() -> TargetTrace:
+    return _tatp_dense("tatp_dense/serve", use_pallas=False, serve=True)
+
+
+@register_target("tatp_dense/serve@mon",
+                 "dense TATP serve-mode block with the counter plane: "
+                 "occupancy/padded/shed lanes land on the device ledger",
+                 protocol=('certified', 'occ'))
+def _t_tatp_dense_serve_mon() -> TargetTrace:
+    return _tatp_dense("tatp_dense/serve@mon", use_pallas=False,
+                       monitor=True, serve=True)
+
+
+@register_target("smallbank_dense/serve",
+                 "dense SmallBank serve-mode block: variable-occupancy "
+                 "lock-slot mask over the 2-wave pipeline",
+                 protocol=('certified',))
+def _t_sb_dense_serve() -> TargetTrace:
+    return _sb_dense("smallbank_dense/serve", use_pallas=False, serve=True)
+
+
+@register_target("smallbank_dense/serve@mon",
+                 "dense SmallBank serve-mode block with the counter "
+                 "plane: occupancy/padded/shed lanes on the ledger",
+                 protocol=('certified',))
+def _t_sb_dense_serve_mon() -> TargetTrace:
+    return _sb_dense("smallbank_dense/serve@mon", use_pallas=False,
+                     monitor=True, serve=True)
+
+
 # ------------------------------------------------- durability (dintdur)
 # Every engine family that owns replicated log rings declares 'durable':
 # passes/durability.py then proves log-before-visible ordering, replica
@@ -933,38 +992,45 @@ TARGET_COST.update({
     # -> 7 (@pallas) -> 4 (@fused) dispatches/step, bytes flat
     "tatp_dense/block": _cost(_TD_GEOM, 9, 216844),
     "tatp_dense/block@pallas": _cost(_TD_GEOM, 7, 216844),
-    "tatp_dense/block@mon": _cost(_TD_GEOM, 11, 216964),
-    "tatp_dense/block@mon+pallas": _cost(_TD_GEOM, 10, 216964,
+    "tatp_dense/block@mon": _cost(_TD_GEOM, 11, 216976),
+    "tatp_dense/block@mon+pallas": _cost(_TD_GEOM, 10, 216976,
                                          wave_expect=_MONPL_TD),
     "tatp_dense/drain": _cost(_TD_GEOM, 9, 216836),
     "tatp_dense/block@hot": _cost(_TD_GEOM, 13, 216864,
                                   wave_expect=_HOT2_TD),
     "tatp_dense/block@hot+pallas": _cost(_TD_GEOM, 7, 216864),
+    # dintserve serve-mode blocks: dispatches/step identical to the
+    # closed-loop rows above (the occupancy mask fuses into the gen
+    # wave), footprint +16 B (@mon +28 B) for the occ/shed step inputs
+    "tatp_dense/serve": _cost(_TD_GEOM, 9, 216860),
+    "tatp_dense/serve@mon": _cost(_TD_GEOM, 11, 216992),
     "tatp_dense/block@fused": _cost(_TD_GEOM, 4, 216844),
     "tatp_dense/block@fused+hot": _cost(_TD_GEOM, 5, 216864,
                                         wave_expect=_TD_FUSED_HOT),
-    "tatp_dense/block@fused+mon": _cost(_TD_GEOM, 7, 216964),
+    "tatp_dense/block@fused+mon": _cost(_TD_GEOM, 7, 216976),
     # dense SmallBank: 8 -> 5 dispatches/step under the megakernels
     "smallbank_dense/block": _cost(_SB_GEOM, 8, 150984),
     "smallbank_dense/block@pallas": _cost(_SB_GEOM, 8, 150984),
-    "smallbank_dense/block@mon": _cost(_SB_GEOM, 10, 151104),
+    "smallbank_dense/block@mon": _cost(_SB_GEOM, 10, 151116),
     "smallbank_dense/block@hot": _cost(_SB_GEOM, 14, 151032,
                                        wave_expect=_HOT2_SB),
     "smallbank_dense/block@hot+pallas": _cost(_SB_GEOM, 10, 151032),
-    "smallbank_dense/block@hot+mon": _cost(_SB_GEOM, 16, 151152,
+    "smallbank_dense/block@hot+mon": _cost(_SB_GEOM, 16, 151164,
                                            wave_expect=_HOT2_SB),
+    "smallbank_dense/serve": _cost(_SB_GEOM, 8, 151000),
+    "smallbank_dense/serve@mon": _cost(_SB_GEOM, 10, 151132),
     "smallbank_dense/block@fused": _cost(_SB_GEOM, 5, 150984),
     "smallbank_dense/block@fused+hot": _cost(_SB_GEOM, 7, 151032),
-    "smallbank_dense/block@fused+mon": _cost(_SB_GEOM, 7, 151104),
+    "smallbank_dense/block@fused+mon": _cost(_SB_GEOM, 7, 151116),
     # generic pipelines: sort-bound, no formula-backed waves -> absolute
     # bytes ceilings instead of a ledger multiple
     "tatp_pipeline/block": _cost(_TD_GEOM, 50, 1610736022,
                                  bytes_budget=256000),
-    "tatp_pipeline/block@mon": _cost(_TD_GEOM, 51, 1610736142,
+    "tatp_pipeline/block@mon": _cost(_TD_GEOM, 51, 1610736154,
                                      bytes_budget=256000),
     "smallbank_pipeline/block": _cost(_SB_GEOM, 36, 1207967480,
                                       bytes_budget=72000),
-    "smallbank_pipeline/block@mon": _cost(_SB_GEOM, 37, 1207967600,
+    "smallbank_pipeline/block@mon": _cost(_SB_GEOM, 37, 1207967612,
                                           bytes_budget=72000),
     # generic replicated shard step: one engine step per trace
     "sharded/tatp": _cost(_DS_GEOM, 62, 4295279296, steps=1.0,
@@ -976,21 +1042,21 @@ TARGET_COST.update({
                                  wave_expect=_DS_EXPECT),
     "dense_sharded/block@pallas": _cost(_DS_GEOM, 31, 459240,
                                         wave_expect=_DS_EXPECT),
-    "dense_sharded/block@mon": _cost(_DS_GEOM, 37, 459720,
+    "dense_sharded/block@mon": _cost(_DS_GEOM, 37, 459768,
                                      wave_expect=_DS_EXPECT),
     "dense_sharded/block@fused": _cost(_DS_GEOM, 28, 459240,
                                        wave_expect=_DS_EXPECT_FUSED),
-    "dense_sharded/block@fused+mon": _cost(_DS_GEOM, 33, 459720,
+    "dense_sharded/block@fused+mon": _cost(_DS_GEOM, 33, 459768,
                                            wave_expect=_DS_EXPECT_FUSED),
     # dense multi-chip SmallBank: 33 -> 30 dispatches/step fused
     "dense_sharded_sb/block": _cost(_DSB_GEOM, 33, 100676560),
-    "dense_sharded_sb/block@mon": _cost(_DSB_GEOM, 37, 100677040),
+    "dense_sharded_sb/block@mon": _cost(_DSB_GEOM, 37, 100677088),
     "dense_sharded_sb/block@hot": _cost(_DSB_GEOM, 39, 100676848,
                                         wave_expect=_DSB_HOT),
     "dense_sharded_sb/block@fused": _cost(_DSB_GEOM, 30, 100676560),
     "dense_sharded_sb/block@fused+hot": _cost(
         _DSB_GEOM, 32, 100676848, wave_expect=_DSB_FUSED_HOT),
-    "dense_sharded_sb/block@fused+mon": _cost(_DSB_GEOM, 34, 100677040),
+    "dense_sharded_sb/block@fused+mon": _cost(_DSB_GEOM, 34, 100677088),
     # 2-D (dcn x ici) SmallBank: the hierarchical route pays +9
     # dispatches/step (each exchange runs ici + dcn stages) to move
     # strictly fewer DCN-axis link bytes than its flat twin — the
@@ -999,7 +1065,7 @@ TARGET_COST.update({
     "multihost_sb/block": _cost(_MHSB_GEOM, 42, 201353056),
     "multihost_sb/block@flat": _cost(_MHSB_GEOM, 33, 201353056,
                                      wave_expect=_MHSB_FLAT),
-    "multihost_sb/block@mon": _cost(_MHSB_GEOM, 46, 201354016),
+    "multihost_sb/block@mon": _cost(_MHSB_GEOM, 46, 201354112),
     "multihost_sb/block@h3": _cost(_MHSB_GEOM_H3, 42, 151014808),
     "multihost_sb/block@h3+flat": _cost(_MHSB_GEOM_H3, 33, 151014808,
                                         wave_expect=_MHSB_FLAT),

@@ -1,0 +1,45 @@
+"""Process-wide builder memoisation.
+
+Every `build_*_runner` returns a triple of stateless jitted closures;
+the only inputs that shape the compiled program are the builder's own
+(hashable) arguments. Callers in different modules still pay a full
+XLA compile each, because each call creates fresh `jax.jit` objects —
+in the test suite that means the same dense engine at the same
+geometry compiles once per test FILE, and in the serving plane a
+restarted engine recompiles its whole width menu. Memoising the
+builder collapses those to one compile per distinct configuration per
+process. Unhashable arguments (shouldn't happen, but e.g. an ad-hoc
+dict) fall back to an uncached build rather than failing.
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+# builders resolve None-valued knobs from these at BUILD time
+# (ops/pallas_gather.resolve_use_*, monitor/txnevents trace defaults),
+# so the ambient values are part of the compiled program's identity —
+# fold a snapshot into the key or a monkeypatched env would hit a
+# stale entry
+_ENV_KNOBS = ("DINT_USE_PALLAS", "DINT_USE_FUSED", "DINT_USE_HOTSET",
+              "DINT_PALLAS_INTERPRET", "DINT_TRACE", "DINT_TRACE_RATE",
+              "DINT_TRACE_CAP")
+
+
+def memoize_builder(fn):
+    cache: dict = {}
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kw):
+        env = tuple(os.environ.get(k) for k in _ENV_KNOBS)
+        try:
+            key = (args, tuple(sorted(kw.items())), env)
+            hit = cache.get(key)         # hashing happens here too (ndarray
+        except TypeError:                # mix= etc.): build uncached
+            return fn(*args, **kw)
+        if hit is None:
+            hit = cache[key] = fn(*args, **kw)
+        return hit
+
+    wrapped.cache = cache        # introspection / explicit clears in tests
+    return wrapped

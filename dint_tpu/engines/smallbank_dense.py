@@ -76,6 +76,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ._memo import memoize_builder
 from ..monitor import counters as mon
 from ..monitor import txnevents as txe
 from ..monitor import waves
@@ -238,6 +239,8 @@ def pipe_step(db: DenseBank, c1: BankCtx, key, *, w: int, n_accounts: int,
               gen_new: bool = True, hot_frac=None, hot_prob=None, mix=None,
               use_pallas: bool = False, use_hotset: bool = False,
               use_fused: bool = False,
+              occupancy: jax.Array | None = None,
+              shed: jax.Array | None = None,
               counters: mon.Counters | None = None,
               ring: txe.TxnRing | None = None,
               tcfg: txe.TraceCfg | None = None):
@@ -276,6 +279,15 @@ def pipe_step(db: DenseBank, c1: BankCtx, key, *, w: int, n_accounts: int,
     (bit-identical by the mirror invariant) while installs keep the
     write-through, so the mirror stays coherent.
 
+    ``occupancy``/``shed`` (device i32 scalars, or None = off): the
+    dintserve variable-occupancy plane — lanes >= occupancy have their
+    lock slots zeroed BEFORE arbitration (their txns never request,
+    grant, compute, or install anything) and ``attempted`` counts only
+    the admitted prefix; ``shed`` mirrors the host-side SLO-shed tally
+    onto the device ledger. Traced scalars: one compiled step serves
+    every occupancy at this width, and occupancy == w is bit-identical
+    to the closed-loop path (tests/test_dintserve.py).
+
     ``counters`` (monitor.Counters | None): the dintmon counter plane —
     txn outcomes from c1's completing stats, S/X arbitration won-vs-lost
     (held-slot rejects split from intra-batch losses), install/log
@@ -312,6 +324,17 @@ def pipe_step(db: DenseBank, c1: BankCtx, key, *, w: int, n_accounts: int,
         l_ac = jnp.zeros((w, L), I32)
     ts_amt = jax.random.randint(kamt, (w,), -TS_AMT_MAX, TS_AMT_MAX + 1,
                                 dtype=I32)
+
+    if occupancy is not None:
+        # serving-plane occupancy mask: the cohort generates full-width
+        # (RNG stream identical to the closed-loop path) and lanes past
+        # the admitted occupancy have their lock slots erased before
+        # arbitration — a padded lane requests nothing, computes nothing,
+        # installs nothing
+        with waves.scope("smallbank_dense", "serve"):
+            occ = jnp.asarray(occupancy, I32)
+            lane_ok = jnp.arange(w, dtype=I32) < occ
+            l_op = jnp.where(lane_ok[:, None], l_op, 0)
 
     active = l_op != 0
     rows = jnp.where(active, l_tb * n_accounts + l_ac, sent)  # [w, L]
@@ -410,7 +433,8 @@ def pipe_step(db: DenseBank, c1: BankCtx, key, *, w: int, n_accounts: int,
 
     new_ctx = BankCtx(
         rows=rows, do_write=do_write, nw=nw, tbl=l_tb, acc=l_ac,
-        attempted=jnp.asarray(w if gen_new else 0, I32),
+        attempted=(occ if occupancy is not None
+                   else jnp.asarray(w if gen_new else 0, I32)),
         committed=committed.sum(dtype=I32),
         ab_lock=(lock_rejected & (l_op[:, 0] != 0)).sum(dtype=I32),
         ab_logic=logic_abort.sum(dtype=I32),
@@ -551,8 +575,17 @@ def pipe_step(db: DenseBank, c1: BankCtx, key, *, w: int, n_accounts: int,
                 mon.CTR_HOT_REFRESH_BYTES:
                     (n_g * 2 * hn * 4) if use_pallas else 0,
             }
+        serve_ctrs = {}
+        if occupancy is not None:
+            serve_ctrs = {
+                mon.CTR_SERVE_OCC_LANES: occ,
+                mon.CTR_SERVE_PAD_LANES: jnp.asarray(w, I32) - occ,
+                mon.CTR_SERVE_SHED_LANES:
+                    jnp.asarray(0 if shed is None else shed, I32),
+            }
         counters = mon.bump(counters, {
             **hot_ctrs,
+            **serve_ctrs,
             mon.CTR_STEPS: 1,
             mon.CTR_TXN_ATTEMPTED: c1.attempted,
             mon.CTR_TXN_COMMITTED: c1.committed,
@@ -576,12 +609,14 @@ def pipe_step(db: DenseBank, c1: BankCtx, key, *, w: int, n_accounts: int,
     return (db, new_ctx, _stats_of(c1)) + extra
 
 
+@memoize_builder
 def build_pipelined_runner(n_accounts: int, w: int = 8192,
                            cohorts_per_block: int = 8, hot_frac=None,
                            hot_prob=None, mix=None, use_pallas=None,
                            use_hotset=None, use_fused=None,
                            monitor: bool = False, trace=None,
-                           trace_rate=None, trace_cap=None):
+                           trace_rate=None, trace_cap=None,
+                           serve: bool = False):
     """jit(scan(pipe_step)) over carry (db, c1). Returns (run, init, drain):
       run(carry, key) -> (carry', stats [cohorts_per_block, N_STATS])
       init(db)        -> carry with one bootstrap cohort in flight
@@ -615,6 +650,12 @@ def build_pipelined_runner(n_accounts: int, w: int = 8192,
     self-contained, and `init.trace_cfg` exposes the resolved TraceCfg
     (None when off) for the host-side drain. Default capacity is
     lossless for a full block: candidate lanes/step x cohorts_per_block.
+
+    ``serve``: the dintserve variable-occupancy mode — run's signature
+    becomes ``run(carry, key, occ, shed)`` with occ/shed i32
+    [cohorts_per_block] arrays scanned alongside the step keys
+    (pipe_step's occupancy/shed). Carry layout, init, and drain are
+    unchanged.
     """
     from ..clients import workloads as wl
     use_hotset = pg.resolve_use_hotset(use_hotset)
@@ -655,22 +696,34 @@ def build_pipelined_runner(n_accounts: int, w: int = 8192,
         ring = out[i] if ring is not None else None
         return out[0], out[1], out[2], cnt, ring
 
-    def scan_fn(carry, key):
+    def scan_fn(carry, x):
+        key, occ, shed = x if serve else (x, None, None)
         db, c1 = carry[:2]
         ring = carry[2] if trace_on else None
         cnt = carry[-1] if monitor else None
         db, new_ctx, stats, cnt, ring = step_mon(db, c1, key, cnt, ring,
+                                                 occupancy=occ, shed=shed,
                                                  **kw_gen)
         out = ((db, new_ctx) + ((ring,) if trace_on else ())
                + ((cnt,) if monitor else ()))
         return out, stats
 
-    def block(carry, key):
+    def _pre(carry):
         if trace_on:
             # each block is one drain window: self-contained ring
             carry = carry[:2] + (txe.reset(carry[2]),) + carry[3:]
-        keys = jax.random.split(key, cohorts_per_block)
-        return jax.lax.scan(scan_fn, carry, keys)
+        return carry
+
+    if serve:
+        def block(carry, key, occ, shed):
+            carry = _pre(carry)
+            keys = jax.random.split(key, cohorts_per_block)
+            return jax.lax.scan(scan_fn, carry, (keys, occ, shed))
+    else:
+        def block(carry, key):
+            carry = _pre(carry)
+            keys = jax.random.split(key, cohorts_per_block)
+            return jax.lax.scan(scan_fn, carry, keys)
 
     def init(db):
         if use_hotset and db.hot_n == 0:

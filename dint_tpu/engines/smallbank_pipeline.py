@@ -42,6 +42,7 @@ import jax
 import jax.numpy as jnp
 
 from ..clients import workloads as wl
+from ._memo import memoize_builder
 from ..monitor import counters as mon
 from ..monitor import waves
 from . import smallbank
@@ -340,6 +341,7 @@ def cohort_step(stacked: smallbank.Shard, key, *, w: int, n_accounts: int,
     return stacked, stats
 
 
+@memoize_builder
 def build_runner(n_accounts: int, w: int = 4096,
                  cohorts_per_block: int = 8, monitor: bool = False):
     """jit(scan(cohort_step)): one dispatch runs `cohorts_per_block` cohorts.

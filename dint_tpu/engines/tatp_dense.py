@@ -99,6 +99,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ._memo import memoize_builder
 from ..monitor import counters as mon
 from ..monitor import txnevents as txe
 from ..monitor import waves
@@ -399,6 +400,8 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
               emit_installs: bool = False, check_magic: bool = True,
               use_pallas: bool = False, use_hotset: bool = False,
               use_fused: bool = False,
+              occupancy: jax.Array | None = None,
+              shed: jax.Array | None = None,
               counters: mon.Counters | None = None,
               ring: txe.TxnRing | None = None,
               tcfg: txe.TraceCfg | None = None):
@@ -437,6 +440,18 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
     ``use_hotset`` (arb prefix stays VMEM-resident inside lock_validate;
     installs write through the mirrors as extra streams). Builders
     resolve via pg.resolve_use_fused (probe-and-degrade).
+
+    ``occupancy``/``shed`` (device i32 scalars, or None = off): the
+    dintserve variable-occupancy plane. Lanes >= occupancy of the freshly
+    generated cohort are forced to no-ops (ops -> NOP, write slots
+    deactivated) BEFORE wave 1, so a partially filled serving cohort
+    certifies exactly the admitted prefix and ``attempted`` counts only
+    real admissions; the value is a traced scalar, so ONE compiled step
+    serves every occupancy at this width. ``shed`` mirrors the host-side
+    SLO-shed tally onto the device ledger (counted like trace_dropped).
+    At occupancy == w the masks are all-true and outputs are
+    bit-identical to the closed-loop path (pinned in
+    tests/test_dintserve.py). None (the default) adds nothing.
 
     ``counters`` (a monitor.Counters, or None = off): the device-resident
     counter plane. When threaded, the step bumps the dintmon registry
@@ -569,6 +584,18 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
         ws_tbl = jnp.zeros((w, 2), I32)
         ws_key = jnp.zeros((w, 2), I32)
         ws_kind = jnp.zeros((w, 2), I32)
+
+    if occupancy is not None:
+        # serving-plane occupancy mask: the cohort is generated full-width
+        # (RNG stream identical to the closed-loop path) and the lanes past
+        # the admitted occupancy are erased before any wave sees them —
+        # NOP lanes gather the sentinel and their write slots never enter
+        # arbitration, so a padded lane is provably traffic-free
+        with waves.scope("tatp_dense", "serve"):
+            occ = jnp.asarray(occupancy, I32)
+            lane_ok = jnp.arange(w, dtype=I32) < occ
+            ops = jnp.where(lane_ok[:, None], ops, Op.NOP)
+            ws_active = ws_active & lane_ok[:, None]
 
     used = ops != Op.NOP
     rows = jnp.where(used, base[tbl] + kk, sent)                # [w, K]
@@ -724,7 +751,8 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
         ws_rows=ws_rows, ws_vv=ws_vv,
         ws_tbl=ws_tbl, ws_key=ws_key, ws_kind=ws_kind,
         ws_active=ws_active,
-        attempted=jnp.asarray(w if gen_new else 0, I32),
+        attempted=(occ if occupancy is not None
+                   else jnp.asarray(w if gen_new else 0, I32)),
         ab_lock=(rw & lock_rejected).sum(dtype=I32),
         ab_missing=((rw & ~lock_rejected & missing)
                     | (is_ro & missing)).sum(dtype=I32),
@@ -759,8 +787,17 @@ def pipe_step(db: DenseDB, c1: DenseCtx, c2: DenseCtx, key, *, w: int,
                 mon.CTR_HOT_COLD_ROWS: lanes - hits,
                 mon.CTR_HOT_REFRESH_BYTES: refresh if use_pallas else 0,
             }
+        serve_ctrs = {}
+        if occupancy is not None:
+            serve_ctrs = {
+                mon.CTR_SERVE_OCC_LANES: occ,
+                mon.CTR_SERVE_PAD_LANES: jnp.asarray(w, I32) - occ,
+                mon.CTR_SERVE_SHED_LANES:
+                    jnp.asarray(0 if shed is None else shed, I32),
+            }
         counters = mon.bump(counters, {
             **hot_ctrs,
+            **serve_ctrs,
             mon.CTR_STEPS: 1,
             mon.CTR_TXN_ATTEMPTED: c2.attempted,
             mon.CTR_TXN_COMMITTED: (c2.ro_commit | c2.alive).sum(dtype=I32),
@@ -859,15 +896,25 @@ def rebase_stamps(db: DenseDB) -> DenseDB:
         return db.replace(arb=arb, step=t * U32(0) + U32(3))
 
 
+@memoize_builder
 def build_pipelined_runner(n_sub: int, w: int = 8192, val_words: int = 10,
                            cohorts_per_block: int = 8, mix=None,
                            check_magic: bool = True, use_pallas=None,
                            use_hotset: bool = False, hot_frac=None,
                            use_fused=None, log_replicas: int = N_SHARDS,
                            monitor: bool = False, trace=None,
-                           trace_rate=None, trace_cap=None):
+                           trace_rate=None, trace_cap=None,
+                           serve: bool = False):
     """jit(scan(pipe_step)) over carry (db, c1, c2); same contract as
     tatp_pipeline.build_pipelined_runner: returns (run, init, drain).
+
+    ``serve``: the dintserve variable-occupancy mode. run's signature
+    becomes ``run(carry, key, occ, shed)`` with occ/shed i32
+    [cohorts_per_block] arrays scanned alongside the step keys — each
+    step masks lanes >= occ[i] to no-ops and mirrors shed[i] onto the
+    device ledger (pipe_step's occupancy/shed). Carry layout, init, and
+    drain are unchanged, so the serving engine reuses the closed-loop
+    drain verbatim.
 
     ``use_pallas``: None = honor DINT_USE_PALLAS env; True/False forces.
     When requested, the Pallas kernels are probed at this runner's lane
@@ -947,24 +994,36 @@ def build_pipelined_runner(n_sub: int, w: int = 8192, val_words: int = 10,
         ring = out[i] if ring is not None else None
         return out[0], out[1], out[2], out[3], cnt, ring
 
-    def scan_fn(carry, key):
+    def scan_fn(carry, x):
+        key, occ, shed = x if serve else (x, None, None)
         db, c1, c2 = carry[:3]
         ring = carry[3] if trace_on else None
         cnt = carry[-1] if monitor else None
         db, new_ctx, c1, stats, cnt, ring = step_mon(
-            db, c1, c2, key, cnt, ring, mix=mix, **kw)
+            db, c1, c2, key, cnt, ring, mix=mix,
+            occupancy=occ, shed=shed, **kw)
         out = ((db, new_ctx, c1) + ((ring,) if trace_on else ())
                + ((cnt,) if monitor else ()))
         return out, stats
 
-    def block(carry, key):
+    def _pre(carry):
         db = jax.lax.cond(carry[0].step >= U32(REBASE_AT), rebase_stamps,
                           lambda d: d, carry[0])
         carry = (db,) + carry[1:]
         if trace_on:     # each drained window is self-contained
             carry = carry[:3] + (txe.reset(carry[3]),) + carry[4:]
-        keys = jax.random.split(key, cohorts_per_block)
-        return jax.lax.scan(scan_fn, carry, keys)
+        return carry
+
+    if serve:
+        def block(carry, key, occ, shed):
+            carry = _pre(carry)
+            keys = jax.random.split(key, cohorts_per_block)
+            return jax.lax.scan(scan_fn, carry, (keys, occ, shed))
+    else:
+        def block(carry, key):
+            carry = _pre(carry)
+            keys = jax.random.split(key, cohorts_per_block)
+            return jax.lax.scan(scan_fn, carry, keys)
 
     def init(db):
         if use_hotset and db.hot_n == 0:

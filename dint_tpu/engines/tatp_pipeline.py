@@ -42,6 +42,7 @@ import jax
 import jax.numpy as jnp
 
 from ..clients import workloads as wl
+from ._memo import memoize_builder
 from ..monitor import counters as mon
 from ..monitor import waves
 from . import tatp
@@ -605,6 +606,7 @@ def pipe_step(stacked: tatp.Shard, c1: PipeCtx, c2: PipeCtx, key, *, w: int,
     return stacked, new_ctx, c1, stats
 
 
+@memoize_builder
 def build_pipelined_runner(n_sub: int, w: int = 4096, val_words: int = 10,
                            cohorts_per_block: int = 8, mix=None,
                            monitor: bool = False):
@@ -661,6 +663,7 @@ def build_pipelined_runner(n_sub: int, w: int = 4096, val_words: int = 10,
     return jax.jit(block, donate_argnums=0), init, drain
 
 
+@memoize_builder
 def build_runner(n_sub: int, w: int = 4096, val_words: int = 10,
                  cohorts_per_block: int = 8, validate: bool = True):
     """jit(scan(cohort_step)): one dispatch runs `cohorts_per_block` cohorts.

@@ -50,7 +50,9 @@ from .counters import (CTR_STEPS, CTR_TXN_ATTEMPTED,  # noqa: F401
                        CTR_REPL_PUSH_HOP2, CTR_ROUTE_OVERFLOW,
                        CTR_RING_HWM, CTR_DISPATCH_XLA, CTR_DISPATCH_PALLAS,
                        CTR_HOT_HITS, CTR_HOT_COLD_ROWS,
-                       CTR_HOT_REFRESH_BYTES, CTR_TRACE_DROPPED)
+                       CTR_HOT_REFRESH_BYTES, CTR_TRACE_DROPPED,
+                       CTR_SERVE_OCC_LANES, CTR_SERVE_PAD_LANES,
+                       CTR_SERVE_SHED_LANES)
 from .trace import (Monitor, TraceWriter, export_chrome_trace,  # noqa: F401
                     profiler_session, read_events)
 # dintscope (the timing half): wave registry + trace attribution — import

@@ -123,6 +123,19 @@ _REGISTRY: tuple[tuple[str, str, str], ...] = (
      "after the per-window event ring filled (keep-first semantics — "
      "the ring never wraps over recorded events, the excess is dropped "
      "and counted here; 0 whenever the ring is sized for the window)"),
+    ("serve_occupancy_lanes", FLOW,
+     "dintserve: lanes carrying real admitted transactions in variable-"
+     "occupancy serving cohorts (occupancy rides the batch as a device "
+     "scalar; serve_occupancy_lanes + serve_padded_lanes = width x "
+     "serving steps — the padding-waste reconciliation identity)"),
+    ("serve_padded_lanes", FLOW,
+     "dintserve: lanes past occupancy masked to no-ops (padding waste "
+     "paid to keep one pre-compiled width hot; see "
+     "serve_occupancy_lanes for the reconciliation identity)"),
+    ("serve_shed_lanes", FLOW,
+     "dintserve: admissions shed by the SLO controller before dispatch, "
+     "mirrored onto the device ledger like trace_dropped (host tally == "
+     "device counter — the graceful-degradation audit trail)"),
 )
 
 ALL_NAMES: tuple[str, ...] = tuple(n for n, _, _ in _REGISTRY)
@@ -163,6 +176,9 @@ CTR_FUSED_DISPATCH = COUNTER_INDEX["fused_dispatch"]
 CTR_ROUTE_ICI_LANES = COUNTER_INDEX["route_ici_lanes"]
 CTR_ROUTE_DCN_LANES = COUNTER_INDEX["route_dcn_lanes"]
 CTR_TRACE_DROPPED = COUNTER_INDEX["trace_dropped"]
+CTR_SERVE_OCC_LANES = COUNTER_INDEX["serve_occupancy_lanes"]
+CTR_SERVE_PAD_LANES = COUNTER_INDEX["serve_padded_lanes"]
+CTR_SERVE_SHED_LANES = COUNTER_INDEX["serve_shed_lanes"]
 
 # the subset defined with IDENTICAL semantics by the dense engines and
 # the generic sort-based pipelines: on the parity workloads

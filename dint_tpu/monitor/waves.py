@@ -229,6 +229,18 @@ _REGISTRY: tuple[tuple[str, str, str, str | None], ...] = (
      "flight-recorder event scatter: ROUTE (wL) + owner LOCK (2wL) + "
      "VOTE (w) + owner INSTALL (2wL) + REPL x2 hops (4wL) + OUTCOME "
      "(w) candidate records per step", "16*(9*w*l + 2*w)"),
+    # --- dintserve variable-occupancy serving (dint_tpu/serve): the
+    # --- lane mask + padding/shed accounting applied before gen hands
+    # --- the cohort to the waves above. Compute-only: the mask is an
+    # --- elementwise compare against a device scalar, no row traffic ----
+    ("tatp_dense", "serve",
+     "serving-plane occupancy mask: lanes past the cohort's admitted "
+     "occupancy forced to no-ops + serve counter bumps — compute-only",
+     None),
+    ("smallbank_dense", "serve",
+     "serving-plane occupancy mask: lock slots past the cohort's "
+     "admitted occupancy zeroed + serve counter bumps — compute-only",
+     None),
 )
 
 

@@ -46,6 +46,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..engines import tatp_dense as td
+from ..engines._memo import memoize_builder
 from ..monitor import counters as mon
 from ..monitor import waves
 from ..ops import pallas_gather as pg
@@ -138,6 +139,7 @@ def _apply_backup(state: ShardState, inst: td.Installs, slot: int,
                          db=state.db.replace(log=log))
 
 
+@memoize_builder
 def build_sharded_pipelined_runner(mesh: Mesh, n_shards: int,
                                    n_sub_global: int, w: int = 4096,
                                    val_words: int = 10,

@@ -63,6 +63,7 @@ from ..engines.smallbank_pipeline import (L, TS_AMT_MAX, VW, N_STATS,
                                           STAT_BAL_DELTA, compute_phase,
                                           gen_cohort, _lock_slots)
 from ..engines.types import Op
+from ..engines._memo import memoize_builder
 from ..monitor import counters as mon
 from ..monitor import txnevents as txe
 from ..monitor import waves
@@ -216,6 +217,7 @@ def _stats_of(c: SBCtx):
                       c.magic_bad, c.bal_delta, c.overflow])
 
 
+@memoize_builder
 def build_sharded_sb_runner(mesh: Mesh, n_shards: int, n_accounts: int,
                             w: int = 2048, cohorts_per_block: int = 8,
                             hot_frac=None, hot_prob=None, mix=None,

@@ -51,6 +51,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..engines import tatp_dense as td
+from ..engines._memo import memoize_builder
 from ..tables import log as logring
 from .dense_sharded import (N_BCK, ShardState, _apply_backup, n_sub_local)
 from .sharded import pcast_varying
@@ -131,6 +132,7 @@ def create_multihost(mesh: Mesh, n_sub_global: int, val_words: int = 10,
     return jax.tree.map(lambda x: jax.device_put(x, shard), state)
 
 
+@memoize_builder
 def build_multihost_runner(mesh: Mesh, n_sub_global: int, w: int = 4096,
                            val_words: int = 10,
                            cohorts_per_block: int = 8, mix=None):

@@ -57,6 +57,7 @@ from ..engines.smallbank_pipeline import (L, TS_AMT_MAX, VW,
                                           compute_phase, gen_cohort,
                                           _lock_slots)
 from ..engines.types import Op
+from ..engines._memo import memoize_builder
 from ..monitor import counters as mon
 from ..monitor import txnevents as txe
 from ..monitor import waves
@@ -109,6 +110,7 @@ def total_balance_global(state: SBShard):
                .astype(np.uint32).view(np.int32).sum(dtype=np.int32))
 
 
+@memoize_builder
 def build_multihost_sb_runner(mesh: Mesh, n_accounts: int, w: int = 2048,
                               cohorts_per_block: int = 8, hot_frac=None,
                               hot_prob=None, mix=None,

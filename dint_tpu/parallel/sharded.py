@@ -37,6 +37,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..engines import smallbank, tatp
+from ..engines._memo import memoize_builder
 from ..engines.types import Batch, Op, Replies
 from ..ops import segments
 
@@ -142,6 +143,7 @@ def replicated_step(shard, batch: Batch, *, n_shards: int,
     return shard, replies, committed
 
 
+@memoize_builder
 def build_sharded_step(mesh: Mesh, n_shards: int, engine: str = "tatp"):
     """jit(shard_map(replicated_step)) over stacked per-device state.
 

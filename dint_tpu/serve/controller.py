@@ -1,0 +1,202 @@
+"""SLO-driven adaptive cohort-width controller for dintserve.
+
+The serving plane pre-compiles one jitted serve step per REGISTERED
+width (compilation is minutes-scale on TPU; recompiling online is not an
+option), so "adaptivity" means choosing among a small fixed menu. The
+controller's inputs are exactly what the round-11 split measures: the
+per-block SERVICE time of each width (observed, EWMA-smoothed, seeded
+from a ServiceModel prior) and the QUEUE delay implied by the current
+offered rate. Everything here is a pure function of (observed rates,
+observed service times, config) — no wall clock, no RNG — so with a
+VirtualClock the controller's width trajectory is a deterministic
+function of the arrival schedule, which is what the CPU tests pin.
+
+Width policy (one decision rule, stated once):
+
+  capacity(w)  = w / service_s(w)          [lanes per second]
+  feasible(w)  = capacity(w) >= offered * headroom
+                 and block_time(w) <= slo_fraction * slo
+  choose       = smallest feasible width   (smallest ⇒ lowest latency:
+                 a half-empty big cohort pays the big cohort's service
+                 time on every admitted txn)
+  none feasible⇒ knee width (max capacity) + saturated flag: past
+                 saturation we maximize throughput and let admission
+                 control shed the excess rather than stall.
+
+Admission policy: the backlog a queue can hold while still meeting the
+SLO is capacity * slo seconds of work; arrivals beyond that bound are
+shed (newest first — the oldest waiters are closest to their deadline
+and shedding them buys nothing). Shed lanes are counted host-side AND
+mirrored into the device counter ledger (serve_shed_lanes), the same
+two-sided audit trail dinttrace uses for trace_dropped.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class ServiceModel:
+    """Prior for per-block service time by width, used to seed the
+    controller's EWMA before any block of that width has run (and as the
+    whole truth under a VirtualClock, where nothing is measured).
+
+    ``base_us`` is the width-independent dispatch floor (host->device
+    hop + kernel launch); ``per_lane_ns`` the marginal lane cost. Both
+    are calibratable from one bench.py run; the DEFAULTS are CPU-scale
+    so virtual tests exercise realistic shapes.
+    """
+    base_us: float = 150.0
+    per_lane_ns: float = 40.0
+
+    def service_us(self, width: int) -> float:
+        return self.base_us + width * self.per_lane_ns * 1e-3
+
+
+@dataclasses.dataclass(frozen=True)
+class ControllerCfg:
+    """Knobs for the width/admission controller."""
+    widths: tuple[int, ...] = (256, 1024, 4096, 8192)
+    slo_us: float = 5_000.0        # p99 queueing-delay objective
+    headroom: float = 1.25         # capacity must beat offered by this
+    slo_fraction: float = 0.5      # block time may eat this much of SLO
+    rate_alpha: float = 0.3        # EWMA weight for offered-rate estimate
+    service_alpha: float = 0.2     # EWMA weight for service-time samples
+    hysteresis_blocks: int = 4     # min blocks between width switches
+
+    def __post_init__(self):
+        assert self.widths == tuple(sorted(self.widths)), \
+            "widths must be ascending"
+
+
+def choose_width(offered_rate: float, service_us: dict[int, float],
+                 cfg: ControllerCfg) -> tuple[int, bool]:
+    """Pick the serving width for an offered rate (lanes/s) given the
+    current per-width service-time estimates. Returns (width,
+    saturated). Pure — this is the function the determinism test pins."""
+    best_cap, knee = -1.0, cfg.widths[-1]
+    for w in cfg.widths:
+        s = service_us[w] * 1e-6
+        cap = w / s
+        if cap > best_cap:
+            best_cap, knee = cap, w
+        ok_rate = cap >= offered_rate * cfg.headroom
+        ok_slo = service_us[w] <= cfg.slo_fraction * cfg.slo_us
+        if ok_rate and ok_slo:
+            return w, False
+    return knee, True
+
+
+def max_backlog(width: int, service_us_w: float, cfg: ControllerCfg) -> int:
+    """Largest admissible queue (in lanes) that can still drain within
+    the SLO at this width's capacity. Admissions past this are shed."""
+    cap = width / (service_us_w * 1e-6)
+    return max(int(cap * cfg.slo_us * 1e-6), width)
+
+
+def recommend_hot_frac(cur: float, hot_hits: int, hot_cold_rows: int, *,
+                       target_hit_rate: float = 0.90,
+                       shrink_above: float = 0.995,
+                       lo: float = 1 / 64, hi: float = 0.5) -> float:
+    """Auto-size the hot-set fraction from the observed hot_hits /
+    hot_cold_rows counters (round 9's hot/cold split): double the hot
+    set while the hit rate misses ``target_hit_rate``, halve it once
+    hits are so saturated (> ``shrink_above``) that HBM is being spent
+    on rows the workload no longer touches. Pure; applied only at
+    engine-rebuild boundaries (hot_frac is a compile-time shape)."""
+    total = hot_hits + hot_cold_rows
+    if total == 0:
+        return cur
+    hit_rate = hot_hits / total
+    if hit_rate < target_hit_rate:
+        return min(cur * 2.0, hi)
+    if hit_rate > shrink_above:
+        return max(cur / 2.0, lo)
+    return cur
+
+
+class WidthController:
+    """Online width/admission controller.
+
+    Feed it per-block observations (``observe_rate`` on every ingest
+    poll, ``observe_service`` after every finished block) and ask
+    ``width()`` before each dispatch. Hysteresis: a switch is only
+    proposed after ``hysteresis_blocks`` blocks at the current width,
+    because a width switch costs a drain (flush the 3-stage pipeline)
+    plus an init at the new width.
+    """
+
+    def __init__(self, cfg: ControllerCfg, model: ServiceModel):
+        self.cfg = cfg
+        self.model = model
+        # EWMA state, seeded from the prior
+        self.service_us = {w: model.service_us(w) for w in cfg.widths}
+        self.offered_rate = 0.0
+        self._cur = cfg.widths[0]
+        self._blocks_at_cur = 0
+        self.saturated = False
+        self.switches: list[tuple[int, int]] = []   # (block_idx, new_width)
+        self._block_idx = 0
+
+    def observe_rate(self, inst_rate: float) -> None:
+        a = self.cfg.rate_alpha
+        self.offered_rate = ((1 - a) * self.offered_rate + a * inst_rate
+                             if self.offered_rate > 0.0 else inst_rate)
+
+    def observe_service(self, width: int, service_us: float) -> None:
+        a = self.cfg.service_alpha
+        self.service_us[width] = ((1 - a) * self.service_us[width]
+                                  + a * service_us)
+        self._block_idx += 1
+        self._blocks_at_cur += 1
+
+    def width(self) -> int:
+        """Current serving width; re-evaluates the policy when the
+        hysteresis window has elapsed."""
+        if self._blocks_at_cur >= self.cfg.hysteresis_blocks \
+                or self._block_idx == 0:
+            want, sat = choose_width(self.offered_rate, self.service_us,
+                                     self.cfg)
+            self.saturated = sat
+            if want != self._cur:
+                self.switches.append((self._block_idx, want))
+                self._cur = want
+                self._blocks_at_cur = 0
+        return self._cur
+
+    def max_backlog(self) -> int:
+        return max_backlog(self._cur, self.service_us[self._cur], self.cfg)
+
+    def snapshot(self) -> dict:
+        return {
+            "width": self._cur,
+            "offered_rate": self.offered_rate,
+            "saturated": self.saturated,
+            "service_us": dict(self.service_us),
+            "switches": list(self.switches),
+        }
+
+
+def simulate_widths(schedule: np.ndarray, cfg: ControllerCfg,
+                    model: ServiceModel, *, cohorts_per_block: int = 2
+                    ) -> list[int]:
+    """Closed-form controller trajectory for an arrival schedule under a
+    pure ServiceModel (no engine, no clock): the sequence of widths the
+    controller would serve each block at. Used by tests and
+    ``tools/dintserve.py simulate`` to show the policy before burning a
+    TPU on it. Deterministic by construction."""
+    ctl = WidthController(cfg, model)
+    widths, i, t = [], 0, 0.0
+    n = len(schedule)
+    while i < n:
+        w = ctl.width()
+        block_s = cohorts_per_block * model.service_us(w) * 1e-6
+        j = int(np.searchsorted(schedule, t + block_s, side="right"))
+        got = j - i
+        ctl.observe_rate(got / block_s)
+        ctl.observe_service(w, model.service_us(w))
+        widths.append(w)
+        i, t = j, t + block_s
+    return widths

@@ -16,6 +16,7 @@ analogue of the reference's run-to-completion prefetch pipeline
 """
 from __future__ import annotations
 
+import collections
 import threading
 import time
 
@@ -42,16 +43,22 @@ class EnginePump:
     service time instead of being folded into one client RTT."""
 
     def __init__(self, profile: Profile, step_fn, state, width: int = 4096,
-                 port: int = 0, flush_us: int = 200, val_words: int = 10):
+                 port: int = 0, flush_us: int = 200, val_words: int = 10,
+                 depth: int = 2, idle_poll_us: int = 50_000):
+        assert depth >= 1
         self.profile = profile
         self.state = state
         self.width = width
         self.val_words = val_words
+        self.depth = depth              # serve_forever keeps <= depth-1
+        self.idle_poll_us = idle_poll_us  # .. in flight; poll bound idle
         self._step = jax.jit(step_fn, donate_argnums=0)
         self.server = ShimServer(port=port, width=width, flush_us=flush_us,
                                  fmt=profile.fmt)
         self.port = self.server.port
         self.batches_served = 0
+        self.occupancy_lanes = 0        # real txns across served batches
+        self.padded_lanes = 0           # width - occupancy padding waste
         self.queue_hist = LatencyHistogram()
         self.service_hist = LatencyHistogram()
         self._stop = threading.Event()
@@ -73,6 +80,8 @@ class EnginePump:
                            width=self.width, val_words=self.val_words)
         t_disp = time.monotonic()
         self.state, replies = self._step(self.state, batch)
+        self.occupancy_lanes += n
+        self.padded_lanes += self.width - n
         if t_arrival is not None:
             self.queue_hist.add(max(t_disp - t_arrival, 0.0) * 1e6)
         return slot, n, wire_type, replies, t_disp
@@ -94,13 +103,22 @@ class EnginePump:
 
     def latency_snapshot(self) -> dict:
         """Queue/service split for artifacts: percentiles + the exact
-        histograms (one sample per served batch)."""
+        histograms (one sample per served batch), plus the dintserve
+        occupancy accounting — width, real vs padded lanes (identity:
+        occupancy + padded == width * batches), and lanes the C++ ring
+        overflowed before the host ever saw them ("shed": the wire-path
+        analogue of serve_shed_lanes)."""
         def side(h):
             return {**{f"{k}_us": round(v, 2)
                        for k, v in h.percentiles().items()},
                     "hist": h.to_dict()}
 
         return {"batches": self.batches_served,
+                "width": self.width,
+                "depth": self.depth,
+                "occupancy_lanes": self.occupancy_lanes,
+                "padded_lanes": self.padded_lanes,
+                "shed": int(self.server.stats()["dropped"]),
                 "queue": side(self.queue_hist),
                 "service": side(self.service_hist)}
 
@@ -114,22 +132,30 @@ class EnginePump:
         return True
 
     def serve_forever(self):
-        """Double-buffered loop: dispatch batch i, then finish batch i-1.
-        The poll is NON-blocking while a batch is in flight — if the ring
-        has a follow-up batch ready it pipelines, otherwise the pending
+        """Depth-k double-buffered loop: up to ``depth - 1`` dispatched
+        batches stay in flight behind the one being accumulated, so
+        device execution of batch i overlaps the C++ RX batching of
+        i+1..i+k-1 AND the host-side reply scatter of i-1 (depth=2 is
+        the classic double buffer this loop shipped with). The poll is
+        NON-blocking while anything is in flight — if the ring has a
+        follow-up batch ready it pipelines, otherwise the oldest pending
         replies go out immediately (closed-loop clients are blocked on
-        them, so waiting here would just add dead reply latency)."""
-        pending = None
+        them, so waiting here would just add dead reply latency); an
+        idle pump parks in the kernel for ``idle_poll_us`` per poll."""
+        pending = collections.deque()
         while not self._stop.is_set():
             got = self.server.poll(
-                timeout_us=0 if pending is not None else 50_000)
-            t_arr = time.monotonic() if got is not None else None
-            new = self._dispatch(got, t_arr) if got is not None else None
-            if pending is not None:
-                self._finish(pending)
-            pending = new
-        if pending is not None:
-            self._finish(pending)
+                timeout_us=0 if pending else self.idle_poll_us)
+            if got is not None:
+                pending.append(self._dispatch(got, time.monotonic()))
+                if len(pending) < self.depth:
+                    continue            # room to run ahead: poll again
+            while pending:
+                self._finish(pending.popleft())
+                if got is not None:
+                    break               # keep only the freshest in flight
+        while pending:
+            self._finish(pending.popleft())
 
     def start(self):
         """Run the serve loop on a background thread (tests/benchmarks)."""

@@ -496,6 +496,83 @@ def sweep_pipeline(name, runner_fn, extras_fn, n_stats, *, widths, cpb,
         run_point(results, f"{name}_latency_w{w}", latency_point(w))
 
 
+def sweep_serve(name, engine, size, *, window_s, open_rates, results,
+                quick, cpb=4, depth=2, slo_us=5_000.0):
+    """dintserve latency-vs-offered-load curve (round 17): drive the
+    always-on serving plane with open-loop Poisson arrival schedules at a
+    ladder of offered rates anchored to a measured saturation probe.
+
+    Point 0 (``_sat``) dumps a block of same-instant arrivals on an empty
+    queue: the width controller parks at its knee width, admission
+    control sheds everything past the SLO-feasible backlog, and the
+    achieved rate IS the serving capacity — the anchor the rate ladder
+    multiplies. Every point's artifact carries offered vs achieved rate,
+    the exact queue/service percentile split (the serving plane's two
+    SLO sensors, measured separately — a closed-loop driver cannot see
+    the queue side at all), the shed count, the width trajectory the
+    controller took, and the SLO verdict, all through the standard
+    artifact schema (percentile block = QUEUEING delay: that is the
+    number the SLO is written against)."""
+    from dint_tpu.serve import ControllerCfg, ServeEngine
+    from dint_tpu.serve import arrivals as arr
+
+    widths = (64, 256) if quick else (256, 1024, 4096, 8192)
+    max_arrivals = 50_000 if quick else 2_000_000
+
+    def make():
+        return ServeEngine(engine, size,
+                           cfg=ControllerCfg(widths=widths, slo_us=slo_us),
+                           cohorts_per_block=cpb, depth=depth,
+                           monitor=True, seed=0)
+
+    def point(schedule_fn, extra_static):
+        def fn():
+            eng = make()
+            eng.warmup()          # compile outside the serving window
+            eng.run(schedule_fn())
+            eng.close()
+            rep = eng.snapshot()
+            p = {**eng.queue_hist.percentiles(),
+                 "hist": eng.queue_hist.to_dict()}
+            extra = dict(extra_static)
+            extra.update(
+                mode="serve", engine=engine, widths=list(widths),
+                offered=rep["offered"], admitted=rep["admitted"],
+                shed=rep["shed"], blocks=rep["blocks"],
+                offered_rate=round(rep["offered_rate"], 1),
+                achieved_rate=round(rep["achieved_rate"], 1),
+                slo_us=slo_us, slo_met=rep["slo_met"],
+                service={**eng.service_hist.percentiles(),
+                         "hist": eng.service_hist.to_dict()},
+                controller=rep["controller"],
+                serve_counters={
+                    k: rep["counters"].get(k, 0)
+                    for k in ("serve_occupancy_lanes", "serve_padded_lanes",
+                              "serve_shed_lanes")})
+            return _metric_json(rep["attempted"], rep["committed"],
+                                rep["elapsed_s"], p, extra)
+
+        return fn
+
+    # saturation probe: every arrival at t=0; shed-don't-stall measured
+    n_probe = min(widths[-1] * cpb * 32, max_arrivals)
+    nm = f"{name}_sat"
+    run_point(results, nm,
+              point(lambda: np.zeros(n_probe), {"load": "sat"}))
+    blk = results.get(nm) or {}
+    peak = blk.get("achieved_rate")   # MetricBlock flattens extra
+    if not peak:
+        return
+
+    for frac in open_rates:
+        rate = max(peak * frac, 1.0)
+        win = min(window_s, max_arrivals / rate)
+        run_point(
+            results, f"{name}_r{int(frac * 100)}pct",
+            point(lambda r=rate, w=win: arr.poisson_schedule(r, w, seed=11),
+                  {"load": frac, "target_rate": round(rate, 1)}))
+
+
 def _timed_client(client, go, window_s):
     go()                             # compile
     client.rec.reset()
@@ -1095,6 +1172,17 @@ def run_all(out: str, window_s: float = 10.0, quick: bool = False,
                                           else float(hot_prob)),
                              "use_hotset": pg.resolve_use_hotset(None)},
                 geom={"l": sd.L, "vw": sd.VW})
+    if want("serve"):
+        # always-on serving plane (dint_tpu/serve): open-loop
+        # latency-vs-offered-load curves with exact queue/service
+        # attribution; RealClock, so rates/latencies are wall-measured
+        sweep_serve("serve_tatp", "tatp_dense", n_sub,
+                    window_s=window_s, open_rates=rates, results=results,
+                    quick=quick, cpb=cpb)
+        sweep_serve("serve_smallbank", "smallbank_dense", n_acc,
+                    window_s=window_s, open_rates=rates, results=results,
+                    quick=quick, cpb=cpb)
+
     sweep_micro(window_s, quick, results, want=want)  # self-gates per point
 
     summary = {"configs": sorted(results),

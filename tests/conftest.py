@@ -14,6 +14,20 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# The tier-1 suite is XLA-compile-bound (hundreds of distinct engine
+# geometries on one core); backend optimization buys runtime we don't
+# measure here — correctness is integer-exact at any opt level, and perf
+# is bench.py/exp.py's job on hardware (neither loads this file). Halves
+# the compile bill. DINT_TEST_FULL_OPT=1 restores full optimization.
+if os.environ.get("DINT_TEST_FULL_OPT", "0") in ("", "0"):
+    jax.config.update("jax_disable_most_optimizations", True)
+
+# NOTE: do NOT enable jax_compilation_cache_dir here — XLA:CPU executable
+# deserialization segfaults this suite (donated buffers + 8 virtual
+# devices, jax 0.4.37): a second jit object loading an executable the
+# same process just serialized corrupts memory. Compile sharing is done
+# in-process instead (dint_tpu.serve.engine.cached_runner).
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

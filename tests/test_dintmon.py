@@ -289,6 +289,54 @@ def test_fused_dispatch_counter_reconciles():
     assert hot_s["hot_hits"] == hot_s["hot_cold_rows"] == 0
 
 
+# -------------------------------------------------------- serve counters
+
+
+def test_serve_counters_reconcile_and_mirror():
+    """Round-17 lane ledger: on the serve-mode builders the occupancy
+    counters account every lane of every SERVING step — occupancy +
+    padded == width x steps (drain steps inject nothing, so they tally
+    nothing) — the shed counter mirrors the host-side admission ledger
+    exactly (the trace_dropped two-sided audit pattern), attempted
+    follows OCCUPANCY rather than width, and the closed-loop builders
+    leave all three at zero."""
+    from dint_tpu.engines import tatp_dense as td
+    from dint_tpu.serve import cached_runner
+
+    run, init, drain = cached_runner(
+        "tatp_dense", N_SUB, val_words=VW, w=W, cohorts_per_block=CPB,
+        monitor=True, trace=False, serve=True)
+    db = td.populate(np.random.default_rng(0), N_SUB, val_words=VW)
+    carry = init(db)
+    occs = [np.array([W, W // 2], np.int32), np.array([0, 7], np.int32),
+            np.array([W, 0], np.int32)]
+    sheds = [np.array([3, 0], np.int32), np.array([0, 0], np.int32),
+             np.array([5, 0], np.int32)]
+    tot = np.zeros(td.N_STATS, np.int64)
+    for i, (o, s) in enumerate(zip(occs, sheds)):
+        carry, st = run(carry, jax.random.fold_in(KEY(0), i), o, s)
+        tot += np.asarray(st, np.int64).sum(axis=0)
+    out = drain(carry)
+    tot += np.asarray(out[1], np.int64).sum(axis=0)
+    snap = M.snapshot(out[-1])
+
+    n_occ = sum(int(o.sum()) for o in occs)
+    steps = len(occs) * CPB                     # serving steps only
+    assert snap["serve_occupancy_lanes"] == n_occ
+    assert snap["serve_padded_lanes"] == steps * W - n_occ
+    assert snap["serve_occupancy_lanes"] + snap["serve_padded_lanes"] \
+        == steps * W                            # the reconciliation identity
+    assert snap["serve_shed_lanes"] == sum(int(s.sum()) for s in sheds) == 8
+    # attempted follows occupancy, not width: masked lanes are no-ops
+    assert snap["txn_attempted"] == tot[td.STAT_ATTEMPTED] == n_occ
+    assert 0 < snap["txn_committed"] == tot[td.STAT_COMMITTED] <= n_occ
+
+    # the closed loop never touches the serve plane
+    _, _, base = _run_tatp_dense(True)
+    assert base["serve_occupancy_lanes"] == base["serve_padded_lanes"] \
+        == base["serve_shed_lanes"] == 0
+
+
 # ------------------------------------------------------- generic engines
 
 

@@ -165,6 +165,9 @@ def test_broken_hot_kernel_degrades_to_xla_partition(monkeypatch, caplog):
     with caplog.at_level("WARNING", logger="dint_tpu.pallas"):
         assert pg.hot_kernels_available(n_idx=64) is False
     assert any("falling back" in r.message for r in caplog.records)
+    # bypass the builder memo: this build must see the broken kernel,
+    # and the degraded build must not be cached for healthy callers
+    sd.build_pipelined_runner.cache.clear()
     run_f, init, drain = sd.build_pipelined_runner(
         100, w=16, cohorts_per_block=2, use_pallas=True, use_hotset=True)
     carry = init(sd.create(100))
@@ -175,6 +178,7 @@ def test_broken_hot_kernel_degrades_to_xla_partition(monkeypatch, caplog):
     assert int(tot[sd.STAT_ATTEMPTED]) == 2 * 16
     assert db.hot_n > 0                       # the partition still ran
     pg._probe_cache.clear()
+    sd.build_pipelined_runner.cache.clear()
 
 
 # --------------------------------------------- end-to-end: smallbank

@@ -150,7 +150,10 @@ def test_broken_kernel_degrades_not_raises(monkeypatch, caplog):
     assert any("falling back" in r.message for r in caplog.records)
     pg._probe_cache.clear()
     # and a builder given the env still comes up on the XLA path
+    # (bypass the builder memo both ways: a healthy cached build would
+    # dodge the broken kernel, and the degraded build must not leak)
     monkeypatch.setenv("DINT_USE_PALLAS", "1")
+    td.build_pipelined_runner.cache.clear()
     run, init, drain = td.build_pipelined_runner(20, w=16, val_words=4,
                                                  cohorts_per_block=2)
     carry = init(td.populate(np.random.default_rng(0), 20, val_words=4))
@@ -162,6 +165,7 @@ def test_broken_kernel_degrades_not_raises(monkeypatch, caplog):
     tot += np.asarray(tail, np.int64).sum(axis=0)
     assert int(tot[td.STAT_ATTEMPTED]) == 2 * 2 * 16  # XLA path ran fine
     pg._probe_cache.clear()
+    td.build_pipelined_runner.cache.clear()
 
 
 # --------------------------------------------- end-to-end engine parity

@@ -1,0 +1,196 @@
+#!/usr/bin/env python
+"""dintserve CLI: drive the always-on serving plane (dint_tpu/serve).
+
+Subcommands
+-----------
+run       serve one open-loop arrival schedule end to end and print the
+          report (offered vs achieved rate, queue/service percentile
+          split, shed count, width trajectory, SLO verdict). --virtual
+          runs under the deterministic VirtualClock + ServiceModel (CPU
+          policy rehearsal); the default RealClock measures wall time.
+simulate  controller-only rehearsal: the width trajectory the SLO
+          controller would take for a schedule under the service-time
+          prior — no engine, no device, milliseconds. Use it to sanity-
+          check a width menu/SLO before burning hardware on it.
+describe  the serving-plane contract: registered serve counters, serve
+          waves, serve targets, and the controller policy knobs.
+
+Examples
+--------
+  python tools/dintserve.py run --engine tatp_dense --size 100000 \\
+      --rate 50000 --window 2 --widths 256,1024,8192 --slo-us 5000
+  python tools/dintserve.py simulate --rate 200000 --window 1 \\
+      --widths 256,1024,4096,8192 --slo-us 2000
+  python tools/dintserve.py describe
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _widths(s: str) -> tuple[int, ...]:
+    return tuple(sorted(int(x) for x in s.split(",")))
+
+
+def _schedule(args):
+    from dint_tpu.serve import arrivals as arr
+    kw = {}
+    if args.kind == "burst":
+        kw = dict(burst_lanes=args.burst_lanes,
+                  burst_every_s=args.burst_every_s)
+    return arr.make_schedule(args.kind, args.rate, args.window,
+                             seed=args.seed, **kw)
+
+
+def cmd_run(args) -> int:
+    from dint_tpu.serve import (ControllerCfg, ServeEngine, ServiceModel,
+                                VirtualClock)
+    cfg = ControllerCfg(widths=_widths(args.widths), slo_us=args.slo_us)
+    model = ServiceModel(base_us=args.model_base_us,
+                         per_lane_ns=args.model_per_lane_ns)
+    eng = ServeEngine(args.engine, args.size, cfg=cfg, model=model,
+                      cohorts_per_block=args.cpb, depth=args.depth,
+                      clock=VirtualClock() if args.virtual else None,
+                      monitor=not args.no_monitor, seed=args.seed)
+    if not args.virtual:
+        eng.warmup()          # compile outside the serving window
+    eng.run(_schedule(args))
+    eng.close()
+    rep = eng.snapshot()
+    if args.json:
+        print(json.dumps(rep))
+        return 0
+    print(f"dintserve {args.engine} size={args.size} "
+          f"widths={list(cfg.widths)} slo={cfg.slo_us:.0f}us "
+          f"{'virtual' if args.virtual else 'real'} clock")
+    print(f"  offered  {rep['offered']} arrivals "
+          f"({rep['offered_rate']:.0f}/s) -> admitted {rep['admitted']}, "
+          f"shed {rep['shed']}")
+    print(f"  achieved {rep['achieved_rate']:.0f} committed/s over "
+          f"{rep['blocks']} blocks ({rep['elapsed_s']:.3f}s)")
+    q, s = rep["queue"], rep["service"]
+    print(f"  queue    p50={q['p50']:.0f}us p99={q['p99']:.0f}us "
+          f"p999={q['p999']:.0f}us")
+    print(f"  service  p50={s['p50']:.0f}us p99={s['p99']:.0f}us "
+          f"p999={s['p999']:.0f}us")
+    print(f"  slo      {'MET' if rep['slo_met'] else 'MISSED'} "
+          f"(queue p99 vs {rep['slo_us']:.0f}us)")
+    ctl = rep["controller"]
+    print(f"  width    final={ctl['width']} switches={ctl['switches']} "
+          f"saturated={ctl['saturated']}")
+    c = rep["counters"]
+    if c:
+        print(f"  lanes    occupancy={c.get('serve_occupancy_lanes', 0)} "
+              f"padded={c.get('serve_padded_lanes', 0)} "
+              f"shed={c.get('serve_shed_lanes', 0)}")
+    return 0 if rep["slo_met"] or args.no_gate else 1
+
+
+def cmd_simulate(args) -> int:
+    from dint_tpu.serve import ControllerCfg, ServiceModel, simulate_widths
+    cfg = ControllerCfg(widths=_widths(args.widths), slo_us=args.slo_us)
+    model = ServiceModel(base_us=args.model_base_us,
+                         per_lane_ns=args.model_per_lane_ns)
+    widths = simulate_widths(_schedule(args), cfg, model,
+                             cohorts_per_block=args.cpb)
+    out = {"widths": sorted(set(widths)), "blocks": len(widths),
+           "trajectory": widths if args.json else None,
+           "final_width": widths[-1] if widths else None}
+    if args.json:
+        print(json.dumps(out))
+        return 0
+    print(f"simulate: {len(widths)} blocks; final width "
+          f"{out['final_width']}")
+    # compressed trajectory: width x run-length
+    runs, prev = [], None
+    for w in widths:
+        if prev is not None and w == prev[0]:
+            prev[1] += 1
+        else:
+            prev = [w, 1]
+            runs.append(prev)
+    print("  trajectory:",
+          " -> ".join(f"{w}x{n}" for w, n in runs) or "(no blocks)")
+    return 0
+
+
+def cmd_describe(args) -> int:
+    from dint_tpu import monitor as mon
+    from dint_tpu.analysis import targets as tg
+    from dint_tpu.monitor import waves
+    from dint_tpu.serve import ControllerCfg
+
+    print("serve counters (dintmon; identity: occupancy + padded == "
+          "width x serving steps, shed mirrored host==device):")
+    for n in mon.ALL_NAMES:
+        if n.startswith("serve_"):
+            print(f"  {n:24s} {mon.COUNTER_DOCS[n].splitlines()[0]}")
+    print("serve waves (dintscope; compute-only, no bytes formula):")
+    for eng in ("tatp_dense", "smallbank_dense"):
+        nm = waves.full_name(eng, "serve")
+        print(f"  {nm}: {waves.WAVE_DOCS[nm].splitlines()[0]}")
+    print("serve targets (dintlint/dintcost/dintdur gated):")
+    for n in sorted(tg.TARGETS):
+        if "/serve" in n:
+            print(f"  {n:28s} {tg.TARGET_DOCS[n].splitlines()[0]}")
+    d = ControllerCfg()
+    print("controller defaults: widths=%s slo_us=%.0f headroom=%.2f "
+          "slo_fraction=%.2f hysteresis_blocks=%d"
+          % (list(d.widths), d.slo_us, d.headroom, d.slo_fraction,
+             d.hysteresis_blocks))
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        prog="dintserve", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    def common(p, engine=False):
+        p.add_argument("--rate", type=float, default=50_000.0,
+                       help="offered arrival rate (txn/s)")
+        p.add_argument("--window", type=float, default=1.0,
+                       help="schedule window (s)")
+        p.add_argument("--kind", default="poisson",
+                       choices=("poisson", "constant", "burst"))
+        p.add_argument("--burst-lanes", type=int, default=4096)
+        p.add_argument("--burst-every-s", type=float, default=0.01)
+        p.add_argument("--widths", default="256,1024,4096,8192")
+        p.add_argument("--slo-us", type=float, default=5_000.0)
+        p.add_argument("--cpb", type=int, default=4,
+                       help="cohorts per dispatched block")
+        p.add_argument("--seed", type=int, default=0)
+        p.add_argument("--model-base-us", type=float, default=150.0)
+        p.add_argument("--model-per-lane-ns", type=float, default=40.0)
+        p.add_argument("--json", action="store_true")
+        if engine:
+            p.add_argument("--engine", default="tatp_dense",
+                           choices=("tatp_dense", "smallbank_dense"))
+            p.add_argument("--size", type=int, default=100_000,
+                           help="n_sub / n_accounts")
+            p.add_argument("--depth", type=int, default=2,
+                           help="host->device pump depth")
+            p.add_argument("--virtual", action="store_true",
+                           help="deterministic VirtualClock + model")
+            p.add_argument("--no-monitor", action="store_true")
+            p.add_argument("--no-gate", action="store_true",
+                           help="exit 0 even when the SLO is missed")
+
+    common(sub.add_parser("run", help="serve a schedule"), engine=True)
+    common(sub.add_parser("simulate",
+                          help="controller-only width trajectory"))
+    sub.add_parser("describe", help="serving-plane contract")
+
+    args = ap.parse_args()
+    return {"run": cmd_run, "simulate": cmd_simulate,
+            "describe": cmd_describe}[args.cmd](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
