@@ -30,6 +30,16 @@ pass fails closed on three checks (ANALYSIS.md "Static cost model"):
                           point of routing ici-then-dcn; checked at
                           every calibrated 2-D geometry, no allowlist
                           entries tolerated
+  overlap-dcn-parity      a double-buffered serve target schedules MORE
+                          DCN-axis link bytes per step than its
+                          unoverlapped twin (targets.TARGET_OVERLAP_TWIN)
+                          — overlap exists to HIDE the exchange under the
+                          lock wave, never to inflate it (round 18)
+  overlap-footprint       the overlapped carry grew past the twin's
+                          footprint plus the priced prefetch double
+                          buffer (targets.OVERLAP_FOOTPRINT): the
+                          in-flight cohort buffer is the ONLY extra state
+                          the overlap is allowed to hold
 
 Every finding names the offending wave/target in `site` and is
 silenceable through the shared dintlint allowlist with a reviewed
@@ -183,6 +193,50 @@ def _hier_dominance_findings(trace: TargetTrace,
     return []
 
 
+def _overlap_findings(trace: TargetTrace,
+                      model: cost.CostModel) -> list[Finding]:
+    from .. import targets as T
+    twin = T.TARGET_OVERLAP_TWIN.get(trace.name)
+    if not twin or twin not in T.TARGETS:
+        return []
+    try:
+        twin_model = cost.model_for(twin)
+    except Exception:  # noqa: BLE001 — twin untraceable here (topology)
+        return []
+    if twin_model.error:
+        return []
+    out: list[Finding] = []
+    dcn, dcn_t = model.dcn_bytes_per_step, twin_model.dcn_bytes_per_step
+    if dcn > dcn_t:
+        out.append(Finding(
+            "cost_budget", "overlap-dcn-parity", SEV_ERROR, trace.name,
+            f"{dcn:g} DCN-axis link bytes/step vs unoverlapped twin "
+            f"{twin} at {dcn_t:g}: the double-buffered route moves MORE "
+            "bytes over the slow axis than the route it is supposed to "
+            "hide — prefetch duplicated an exchange",
+            site=twin,
+            suggestion="the prefetched buckets must be CONSUMED next "
+                       "step, never re-exchanged — diff the per-wave "
+                       "dcn_bytes blocks of `tools/dintcost.py report "
+                       f"{trace.name} {twin} --json`"))
+    allowance = cost.eval_budget_bytes(T.OVERLAP_FOOTPRINT, model.geom,
+                                       0.0) or 0.0
+    fp, fp_t = model.footprint_bytes, twin_model.footprint_bytes
+    if fp > fp_t + allowance:
+        out.append(Finding(
+            "cost_budget", "overlap-footprint", SEV_ERROR, trace.name,
+            f"{fp} B persistent footprint vs twin {twin} at {fp_t} B + "
+            f"{allowance:g} B priced double buffer "
+            f"(targets.OVERLAP_FOOTPRINT): the overlap carry holds more "
+            "than the one in-flight cohort it is allowed",
+            site=twin,
+            suggestion="the prefetch state is (key, occ, routed op/row "
+                       "buckets) and nothing else — find the extra leaf "
+                       f"with `tools/dintcost.py report {trace.name} "
+                       f"{twin}`"))
+    return out
+
+
 @register_pass("cost_budget")
 def cost_budget(trace: TargetTrace) -> list[Finding]:
     """Derives the target's static cost model and enforces ledger
@@ -206,4 +260,5 @@ def cost_budget(trace: TargetTrace) -> list[Finding]:
     out += _budget_findings(trace, meta, model)
     out += _dominance_findings(trace, model)
     out += _hier_dominance_findings(trace, model)
+    out += _overlap_findings(trace, model)
     return out

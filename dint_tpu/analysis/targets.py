@@ -129,6 +129,21 @@ def _mesh2d(n_hosts: int, n_ici: int):
 # the flat lowering at every calibrated geometry (ISSUE 11's gate)
 TARGET_FLAT_TWIN: dict[str, str] = {}
 
+# double-buffered (overlap=True) serve targets -> their unoverlapped
+# twin on the SAME mesh/width: passes/cost_budget.py fails
+# overlap-dcn-parity unless the overlapped route schedules NO MORE
+# DCN-axis link bytes per step than the twin (overlap must hide the
+# exchange under the lock wave, not inflate it), and overlap-footprint
+# unless the overlapped carry grows by at most the priced double buffer
+# (OVERLAP_FOOTPRINT below) over the twin's footprint (round-18 gate).
+TARGET_OVERLAP_TWIN: dict[str, str] = {}
+
+# the in-flight prefetch buffer the overlap path carries per device:
+# routed op + row-loc bucket planes (2 x i32[d*cap] with
+# cap = 2*ceil(w*l/d)) plus the replayed source (key u32[2] + occ i32,
+# 12 B); global bytes = d x per-device
+OVERLAP_FOOTPRINT = "d*(8*d*(2*((w*l+d-1)//d)) + 12)"
+
 
 # ------------------------------------------------------------ dense TATP
 
@@ -640,16 +655,24 @@ def _t_dense_sharded_sb_fused_mon() -> TargetTrace:
 def _multihost_sb(name: str, n_hosts: int, n_ici: int,
                   hierarchical: bool = True,
                   monitor: bool = False,
-                  trace: bool = False) -> TargetTrace:
+                  trace: bool = False,
+                  serve: bool = False,
+                  overlap: bool = False) -> TargetTrace:
     from ..parallel import multihost_sb as mhs
     mesh = _mesh2d(n_hosts, n_ici)
     d = n_hosts * n_ici
     run, init, _ = mhs.build_multihost_sb_runner(
         mesh, _N_ACCT * d, w=_W, cohorts_per_block=_BLK,
-        hierarchical=hierarchical, monitor=monitor, trace=trace)
+        hierarchical=hierarchical, monitor=monitor, trace=trace,
+        serve=serve, overlap=overlap)
     carry = _abstract(lambda: init(mhs.create_multihost_sb(
         mesh, _N_ACCT * d)))
-    return trace_target(name, run, (carry, _key_aval()),
+    args = (carry, _key_aval())
+    if serve:
+        # mesh serve signature: per-(host, chip, cohort) occ/shed arrays
+        a = jax.ShapeDtypeStruct((n_hosts, n_ici, _BLK), jnp.int32)
+        args += (a, a)
+    return trace_target(name, run, args,
                         mesh_axes=(mhs.DCN_AXIS, mhs.ICI_AXIS))
 
 
@@ -804,6 +827,77 @@ def _t_sb_dense_serve() -> TargetTrace:
 def _t_sb_dense_serve_mon() -> TargetTrace:
     return _sb_dense("smallbank_dense/serve@mon", use_pallas=False,
                      monitor=True, serve=True)
+
+
+# --------------------------------------- dintmesh serving plane (round 18)
+# The mesh-wide serve-mode blocks: the round-14 2-D cross-shard step in
+# the serve=True cohort form (per-(host, chip, cohort) occupancy mask +
+# serve counter bumps) that serve/mesh.py's MeshServeEngine drives. The
+# @overlap variants serve through the double-buffered route (cohort
+# i+1's exchange issued under cohort i's owner waves); they keep the
+# full protocol flags because the runner pins them bit-identical to the
+# unoverlapped route, and cost_budget's overlap-dcn-parity /
+# overlap-footprint checks (TARGET_OVERLAP_TWIN above) price exactly
+# what the overlap costs BEFORE any hardware run.
+
+
+@register_target("multihost_sb/serve",
+                 "2-D mesh serve-mode block: variable-occupancy mask "
+                 "over the hierarchical cross-shard step (dintmesh "
+                 "steady state)",
+                 protocol=('certified', 'replicated'))
+def _t_multihost_sb_serve() -> TargetTrace:
+    return _multihost_sb("multihost_sb/serve", 4, 2, serve=True)
+
+
+@register_target("multihost_sb/serve@flat",
+                 "2-D mesh serve-mode block lowered with flat tuple-axis "
+                 "all_to_all (dominance twin of the serve family)",
+                 protocol=('certified', 'replicated'))
+def _t_multihost_sb_serve_flat() -> TargetTrace:
+    return _multihost_sb("multihost_sb/serve@flat", 4, 2,
+                         hierarchical=False, serve=True)
+
+
+@register_target("multihost_sb/serve@mon",
+                 "2-D mesh serve-mode block with the counter plane: "
+                 "occupancy/padded/shed lanes + the per-axis route split "
+                 "on every device ledger",
+                 protocol=('certified', 'replicated'))
+def _t_multihost_sb_serve_mon() -> TargetTrace:
+    return _multihost_sb("multihost_sb/serve@mon", 4, 2, monitor=True,
+                         serve=True)
+
+
+@register_target("multihost_sb/serve@overlap",
+                 "2-D mesh serve-mode block with the double-buffered "
+                 "route: cohort i+1's ici-then-dcn exchange issued under "
+                 "cohort i's owner waves (bit-identical pin vs @serve)",
+                 protocol=('certified', 'replicated'))
+def _t_multihost_sb_serve_overlap() -> TargetTrace:
+    return _multihost_sb("multihost_sb/serve@overlap", 4, 2, serve=True,
+                         overlap=True)
+
+
+@register_target("multihost_sb/serve@overlap+mon",
+                 "double-buffered mesh serve block with the counter "
+                 "plane (route_prefetch_lanes lands on the ledger)",
+                 protocol=('certified', 'replicated'))
+def _t_multihost_sb_serve_overlap_mon() -> TargetTrace:
+    return _multihost_sb("multihost_sb/serve@overlap+mon", 4, 2,
+                         monitor=True, serve=True, overlap=True)
+
+
+TARGET_FLAT_TWIN.update({
+    "multihost_sb/serve": "multihost_sb/serve@flat",
+    "multihost_sb/serve@mon": "multihost_sb/serve@flat",
+    "multihost_sb/serve@overlap": "multihost_sb/serve@flat",
+})
+
+TARGET_OVERLAP_TWIN.update({
+    "multihost_sb/serve@overlap": "multihost_sb/serve",
+    "multihost_sb/serve@overlap+mon": "multihost_sb/serve@mon",
+})
 
 
 # ------------------------------------------------- durability (dintdur)
@@ -992,8 +1086,8 @@ TARGET_COST.update({
     # -> 7 (@pallas) -> 4 (@fused) dispatches/step, bytes flat
     "tatp_dense/block": _cost(_TD_GEOM, 9, 216844),
     "tatp_dense/block@pallas": _cost(_TD_GEOM, 7, 216844),
-    "tatp_dense/block@mon": _cost(_TD_GEOM, 11, 216976),
-    "tatp_dense/block@mon+pallas": _cost(_TD_GEOM, 10, 216976,
+    "tatp_dense/block@mon": _cost(_TD_GEOM, 11, 216980),
+    "tatp_dense/block@mon+pallas": _cost(_TD_GEOM, 10, 216980,
                                          wave_expect=_MONPL_TD),
     "tatp_dense/drain": _cost(_TD_GEOM, 9, 216836),
     "tatp_dense/block@hot": _cost(_TD_GEOM, 13, 216864,
@@ -1003,34 +1097,34 @@ TARGET_COST.update({
     # closed-loop rows above (the occupancy mask fuses into the gen
     # wave), footprint +16 B (@mon +28 B) for the occ/shed step inputs
     "tatp_dense/serve": _cost(_TD_GEOM, 9, 216860),
-    "tatp_dense/serve@mon": _cost(_TD_GEOM, 11, 216992),
+    "tatp_dense/serve@mon": _cost(_TD_GEOM, 11, 216996),
     "tatp_dense/block@fused": _cost(_TD_GEOM, 4, 216844),
     "tatp_dense/block@fused+hot": _cost(_TD_GEOM, 5, 216864,
                                         wave_expect=_TD_FUSED_HOT),
-    "tatp_dense/block@fused+mon": _cost(_TD_GEOM, 7, 216976),
+    "tatp_dense/block@fused+mon": _cost(_TD_GEOM, 7, 216980),
     # dense SmallBank: 8 -> 5 dispatches/step under the megakernels
     "smallbank_dense/block": _cost(_SB_GEOM, 8, 150984),
     "smallbank_dense/block@pallas": _cost(_SB_GEOM, 8, 150984),
-    "smallbank_dense/block@mon": _cost(_SB_GEOM, 10, 151116),
+    "smallbank_dense/block@mon": _cost(_SB_GEOM, 10, 151120),
     "smallbank_dense/block@hot": _cost(_SB_GEOM, 14, 151032,
                                        wave_expect=_HOT2_SB),
     "smallbank_dense/block@hot+pallas": _cost(_SB_GEOM, 10, 151032),
-    "smallbank_dense/block@hot+mon": _cost(_SB_GEOM, 16, 151164,
+    "smallbank_dense/block@hot+mon": _cost(_SB_GEOM, 16, 151168,
                                            wave_expect=_HOT2_SB),
     "smallbank_dense/serve": _cost(_SB_GEOM, 8, 151000),
-    "smallbank_dense/serve@mon": _cost(_SB_GEOM, 10, 151132),
+    "smallbank_dense/serve@mon": _cost(_SB_GEOM, 10, 151136),
     "smallbank_dense/block@fused": _cost(_SB_GEOM, 5, 150984),
     "smallbank_dense/block@fused+hot": _cost(_SB_GEOM, 7, 151032),
-    "smallbank_dense/block@fused+mon": _cost(_SB_GEOM, 7, 151116),
+    "smallbank_dense/block@fused+mon": _cost(_SB_GEOM, 7, 151120),
     # generic pipelines: sort-bound, no formula-backed waves -> absolute
     # bytes ceilings instead of a ledger multiple
     "tatp_pipeline/block": _cost(_TD_GEOM, 50, 1610736022,
                                  bytes_budget=256000),
-    "tatp_pipeline/block@mon": _cost(_TD_GEOM, 51, 1610736154,
+    "tatp_pipeline/block@mon": _cost(_TD_GEOM, 51, 1610736158,
                                      bytes_budget=256000),
     "smallbank_pipeline/block": _cost(_SB_GEOM, 36, 1207967480,
                                       bytes_budget=72000),
-    "smallbank_pipeline/block@mon": _cost(_SB_GEOM, 37, 1207967612,
+    "smallbank_pipeline/block@mon": _cost(_SB_GEOM, 37, 1207967616,
                                           bytes_budget=72000),
     # generic replicated shard step: one engine step per trace
     "sharded/tatp": _cost(_DS_GEOM, 62, 4295279296, steps=1.0,
@@ -1042,21 +1136,21 @@ TARGET_COST.update({
                                  wave_expect=_DS_EXPECT),
     "dense_sharded/block@pallas": _cost(_DS_GEOM, 31, 459240,
                                         wave_expect=_DS_EXPECT),
-    "dense_sharded/block@mon": _cost(_DS_GEOM, 37, 459768,
+    "dense_sharded/block@mon": _cost(_DS_GEOM, 37, 459784,
                                      wave_expect=_DS_EXPECT),
     "dense_sharded/block@fused": _cost(_DS_GEOM, 28, 459240,
                                        wave_expect=_DS_EXPECT_FUSED),
-    "dense_sharded/block@fused+mon": _cost(_DS_GEOM, 33, 459768,
+    "dense_sharded/block@fused+mon": _cost(_DS_GEOM, 33, 459784,
                                            wave_expect=_DS_EXPECT_FUSED),
     # dense multi-chip SmallBank: 33 -> 30 dispatches/step fused
     "dense_sharded_sb/block": _cost(_DSB_GEOM, 33, 100676560),
-    "dense_sharded_sb/block@mon": _cost(_DSB_GEOM, 37, 100677088),
+    "dense_sharded_sb/block@mon": _cost(_DSB_GEOM, 37, 100677104),
     "dense_sharded_sb/block@hot": _cost(_DSB_GEOM, 39, 100676848,
                                         wave_expect=_DSB_HOT),
     "dense_sharded_sb/block@fused": _cost(_DSB_GEOM, 30, 100676560),
     "dense_sharded_sb/block@fused+hot": _cost(
         _DSB_GEOM, 32, 100676848, wave_expect=_DSB_FUSED_HOT),
-    "dense_sharded_sb/block@fused+mon": _cost(_DSB_GEOM, 34, 100677088),
+    "dense_sharded_sb/block@fused+mon": _cost(_DSB_GEOM, 34, 100677104),
     # 2-D (dcn x ici) SmallBank: the hierarchical route pays +9
     # dispatches/step (each exchange runs ici + dcn stages) to move
     # strictly fewer DCN-axis link bytes than its flat twin — the
@@ -1065,10 +1159,22 @@ TARGET_COST.update({
     "multihost_sb/block": _cost(_MHSB_GEOM, 42, 201353056),
     "multihost_sb/block@flat": _cost(_MHSB_GEOM, 33, 201353056,
                                      wave_expect=_MHSB_FLAT),
-    "multihost_sb/block@mon": _cost(_MHSB_GEOM, 46, 201354112),
+    "multihost_sb/block@mon": _cost(_MHSB_GEOM, 46, 201354144),
     "multihost_sb/block@h3": _cost(_MHSB_GEOM_H3, 42, 151014808),
     "multihost_sb/block@h3+flat": _cost(_MHSB_GEOM_H3, 33, 151014808,
                                         wave_expect=_MHSB_FLAT),
+    # dintmesh serve-mode blocks (round 18): dispatches/step match the
+    # closed-loop rows (the occupancy mask fuses into gen), footprint
+    # +128 B for the [h, d/h, steps] occ/shed inputs; @overlap carries
+    # the priced double buffer (OVERLAP_FOOTPRINT = 6240 B at this
+    # geometry) and moves the SAME link bytes one step early — the
+    # overlap-dcn-parity / overlap-footprint checks pin both statically
+    "multihost_sb/serve": _cost(_MHSB_GEOM, 42, 201353184),
+    "multihost_sb/serve@flat": _cost(_MHSB_GEOM, 33, 201353184,
+                                     wave_expect=_MHSB_FLAT),
+    "multihost_sb/serve@mon": _cost(_MHSB_GEOM, 47, 201354272),
+    "multihost_sb/serve@overlap": _cost(_MHSB_GEOM, 44, 201359424),
+    "multihost_sb/serve@overlap+mon": _cost(_MHSB_GEOM, 50, 201360512),
     # 2-D TATP (parallel/multihost.py, flat tuple-axis collectives):
     # replication traffic pre-dates wave scoping -> absolute bytes
     # ceiling like the pipeline targets, not a ledger multiple

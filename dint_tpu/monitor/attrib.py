@@ -78,6 +78,12 @@ WAVE_ALIASES: dict[str, str] = {
         ("smallbank_dense", "log_append", "install_log"),
         ("dense_sharded_sb", "arbitrate", "lock_validate"),
         ("dense_sharded_sb", "install_route", "install_log"),
+        # overlap=True moves the mesh route's exchange one step early
+        # under its own scope — an overlap-on vs overlap-off A/B sees
+        # `route` vanish on one side; fold it into route_prefetch so the
+        # gate compares the route's total time and names a no-longer-
+        # hidden DCN wave as a route_prefetch regression
+        ("multihost_sb", "route", "route_prefetch"),
     )
 }
 for _src, _dst in WAVE_ALIASES.items():

@@ -136,6 +136,13 @@ _REGISTRY: tuple[tuple[str, str, str], ...] = (
      "dintserve: admissions shed by the SLO controller before dispatch, "
      "mirrored onto the device ledger like trace_dropped (host tally == "
      "device counter — the graceful-degradation audit trail)"),
+    ("route_prefetch_lanes", FLOW,
+     "valid lock-request lanes whose routed buckets were exchanged one "
+     "step EARLY by the double-buffered mesh serve path (overlap=True): "
+     "the DCN all_to_all of cohort i+1 issued under cohort i's owner "
+     "waves. Summed over devices and a full run+drain it equals "
+     "lock_requests — every prefetched lane is arbitrated exactly once; "
+     "0 on unoverlapped routes"),
 )
 
 ALL_NAMES: tuple[str, ...] = tuple(n for n, _, _ in _REGISTRY)
@@ -179,6 +186,7 @@ CTR_TRACE_DROPPED = COUNTER_INDEX["trace_dropped"]
 CTR_SERVE_OCC_LANES = COUNTER_INDEX["serve_occupancy_lanes"]
 CTR_SERVE_PAD_LANES = COUNTER_INDEX["serve_padded_lanes"]
 CTR_SERVE_SHED_LANES = COUNTER_INDEX["serve_shed_lanes"]
+CTR_ROUTE_PREFETCH_LANES = COUNTER_INDEX["route_prefetch_lanes"]
 
 # the subset defined with IDENTICAL semantics by the dense engines and
 # the generic sort-based pipelines: on the parity workloads

@@ -241,6 +241,21 @@ _REGISTRY: tuple[tuple[str, str, str, str | None], ...] = (
      "serving-plane occupancy mask: lock slots past the cohort's "
      "admitted occupancy zeroed + serve counter bumps — compute-only",
      None),
+    # --- dintmesh (round 18): the 2-D mesh as one open-loop service.
+    # --- serve is the same compute-only admission mask as the dense
+    # --- engines; route_prefetch is the double-buffered route — the SAME
+    # --- 2wL bucket exchange as `route`, issued one step EARLY so the
+    # --- host-aggregated DCN all_to_all of cohort i+1 rides under cohort
+    # --- i's arbitrate/reply waves (an overlap regression shows up as
+    # --- this wave's wall-clock time growing back toward `route`'s) -----
+    ("multihost_sb", "serve",
+     "mesh serving-plane occupancy mask: lock slots past the cohort's "
+     "per-device admitted occupancy zeroed + serve counter bumps — "
+     "compute-only", None),
+    ("multihost_sb", "route_prefetch",
+     "double-buffered lock/read routing: cohort i+1's 2wL bucket "
+     "exchange (ICI then host-aggregated DCN, same bytes as route) "
+     "issued under cohort i's owner waves", "2*2*w*l*8"),
 )
 
 

@@ -116,7 +116,8 @@ def build_multihost_sb_runner(mesh: Mesh, n_accounts: int, w: int = 2048,
                               hot_prob=None, mix=None,
                               hierarchical: bool = False,
                               monitor: bool = False, trace=None,
-                              trace_rate=None, trace_cap=None):
+                              trace_rate=None, trace_cap=None,
+                              serve: bool = False, overlap: bool = False):
     """jit(shard_map(scan(step))) over the 2-D mesh. Contract mirrors
     build_sharded_sb_runner: (run, init, drain); stats psummed ici then
     dcn. ``hierarchical`` picks the two-stage (ici, dcn) exchange or the
@@ -136,7 +137,35 @@ def build_multihost_sb_runner(mesh: Mesh, n_accounts: int, w: int = 2048,
     events additionally carry the txnevents.ROUTE_DCN aux bit when the
     owner lives on another host — the hop that pays DCN bytes is visible
     per transaction, not just in the route_*_lanes totals. Off = routed
-    fields, jaxpr, and outputs all bit-identical."""
+    fields, jaxpr, and outputs all bit-identical.
+
+    ``serve``: the dintserve variable-occupancy cohort form (round 17's
+    dense-engine contract, lifted to the mesh). ``run(carry, key, occ,
+    shed)`` takes per-device occupancy/shed-mirror arrays shaped
+    [n_hosts, n_ici, cohorts_per_block] i32; lock slots past each
+    device's admitted occupancy are zeroed AFTER full-width generation,
+    so occ == w replays the closed loop bit-identically and the serve
+    counter trio reconciles per device (occupancy + padded == w x
+    serving steps, summed over the mesh).
+
+    ``overlap``: double-buffered cohorts (requires ``serve``; refuses
+    ``trace`` — txn ids are stamped with the generation step). Each step
+    PREFETCHES cohort i+1's routed lock/read buckets — generation plus
+    the hierarchical ICI-then-DCN exchange under the ``route_prefetch``
+    wave — and carries them (p_key, p_occ, r_op, r_row) to the next
+    step, so XLA can start cohort i+1's host-aggregated DCN all_to_all
+    while cohort i's arbitrate/reply waves still run on data already on
+    device. Cohort i's source-side locals (lock slots, amounts, reply
+    back-map) are REGENERATED from the carried key instead of carried —
+    generation is pure in (key, occ), so the replay is free of comm and
+    the extra in-flight state is just the 2 routed bucket fields
+    (priced by dintcost's overlap-footprint expectation). Pinned
+    bit-identical to the unoverlapped serve route: the init step starts
+    one earlier (a bootstrap step arbitrates an empty prefetch buffer)
+    and the drain runs two flush steps, so cohort j is arbitrated at
+    step 2+j and installed at 3+j in BOTH modes — the entire final
+    state (primaries, stamps, backups, log rings) matches exactly; only
+    the per-block stats ALIGNMENT shifts (compare run+drain totals)."""
     n_hosts, n_ici = mesh.devices.shape
     if n_hosts < 3:
         raise ValueError("multihost replication needs >= 3 hosts "
@@ -148,6 +177,15 @@ def build_multihost_sb_runner(mesh: Mesh, n_accounts: int, w: int = 2048,
     sent = m1 - 1
     oob = m1
     cap = 2 * ((w * L + d - 1) // d)
+    if overlap and not serve:
+        raise ValueError("overlap=True requires serve=True: the double-"
+                         "buffered route is defined over admitted "
+                         "serving cohorts (occ rides the prefetch carry)")
+    if overlap and txe.trace_enabled(trace):
+        raise ValueError("overlap=True is incompatible with trace: "
+                         "dinttrace txn ids are stamped with the "
+                         "generation step, which the double buffer "
+                         "shifts by one")
     kw_gen = {}
     if hot_frac is not None:
         kw_gen["hot_frac"] = hot_frac
@@ -178,20 +216,23 @@ def build_multihost_sb_runner(mesh: Mesh, n_accounts: int, w: int = 2048,
                                   (DCN_AXIS, ICI_AXIS), 0, 0,
                                   tiled=False).reshape(d * cap)
 
-    def local_step(state: SBShard, c1: SBCtx, key, cnt, ring,
-                   gen_new=True):
-        h = jax.lax.axis_index(DCN_AXIS)
-        c = jax.lax.axis_index(ICI_AXIS)
-        dev = h * n_ici + c             # global shard id, dcn-major
-        t = state.step
+    def _src_cohort(key, occ_i, dev, gen_new):
+        """Source-side cohort materialization, pure in (key, occ_i, dev):
+        full-width generation from the cohort key, then (serve) zero the
+        lock slots of lanes past the admitted occupancy — so occ == w is
+        value-identical to the closed loop, and the overlap path can
+        REPLAY this from a carried (key, occ) to recover the in-flight
+        cohort's locals without carrying them."""
         kgen, kamt = jax.random.split(jax.random.fold_in(key, dev))
-
-        # ---- wave 1: generate + route lock/read requests to owners ----
         if gen_new:
             with waves.scope("multihost_sb", "gen"):
                 ttype, a1, a2 = gen_cohort(kgen, w, n_accounts, mix=mix,
                                            **kw_gen)
                 l_op, l_tb, l_ac = _lock_slots(ttype, a1, a2)
+            if occ_i is not None:
+                with waves.scope("multihost_sb", "serve"):
+                    lane_ok = jnp.arange(w, dtype=I32) < occ_i
+                    l_op = jnp.where(lane_ok[:, None], l_op, 0)
         else:
             ttype = jnp.zeros((w,), I32)
             l_op = jnp.zeros((w, L), I32)
@@ -199,31 +240,90 @@ def build_multihost_sb_runner(mesh: Mesh, n_accounts: int, w: int = 2048,
             l_ac = jnp.zeros((w, L), I32)
         ts_amt = jax.random.randint(kamt, (w,), -TS_AMT_MAX,
                                     TS_AMT_MAX + 1, dtype=I32)
+        return ttype, l_op, l_tb, l_ac, ts_amt
 
-        if ring is not None:
-            # dinttrace ids: one per generated txn, identical on every
-            # device/host that touches it (routed copies below carry it)
-            tu = jnp.asarray(t).astype(U32)
-            du = dev.astype(U32)
-            lane_w = jnp.arange(w, dtype=U32)
-            txn_new = (tu * U32(d) + du) * U32(w) + lane_w
-            txn_c1 = ((tu - U32(1)) * U32(d) + du) * U32(w) + lane_w
+    def _route_src(l_op, l_tb, l_ac):
+        """Destination shard / bucket position / validity for every lock
+        slot — the source half of the route; no collectives."""
+        active = (l_op != 0).reshape(-1)
+        dest = (l_ac.reshape(-1) % d).astype(I32)
+        row_loc = (l_tb.reshape(-1) * n_loc
+                   + l_ac.reshape(-1) // d).astype(I32)
+        pos = _positions(dest, active, d)
+        valid = active & (pos < cap)
+        return active, dest, row_loc, pos, valid
 
-        with waves.scope("multihost_sb", "route"):
-            active = (l_op != 0).reshape(-1)
-            dest = (l_ac.reshape(-1) % d).astype(I32)
-            row_loc = (l_tb.reshape(-1) * n_loc
-                       + l_ac.reshape(-1) // d).astype(I32)
-            pos = _positions(dest, active, d)
-            valid = active & (pos < cap)
+    def _empty_pf():
+        """Prefetch carry (p_key, p_occ, r_op, r_row): the key + admitted
+        occupancy of the in-flight cohort plus its already-exchanged
+        routed buckets. Empty = the bootstrap/flush no-op cohort."""
+        return (jnp.zeros((2,), U32), jnp.asarray(0, I32),
+                jnp.zeros((d * cap,), I32), jnp.zeros((d * cap,), I32))
 
-            fields = [l_op.reshape(-1), row_loc]
+    def local_step(state: SBShard, c1: SBCtx, pf, key, occ_i, shed_i,
+                   cnt, ring, gen_new=True):
+        h = jax.lax.axis_index(DCN_AXIS)
+        c = jax.lax.axis_index(ICI_AXIS)
+        dev = h * n_ici + c             # global shard id, dcn-major
+        t = state.step
+
+        # ---- wave 1: generate + route lock/read requests to owners ----
+        p_valid = r_txn = None
+        if overlap:
+            # prefetch cohort i+1: generate from THIS step's key and push
+            # the routed buckets through the exchange NOW — the host-
+            # aggregated DCN all_to_all runs under cohort i's owner waves
+            if gen_new:
+                _, n_op, n_tb, n_ac, _ = _src_cohort(key, occ_i, dev,
+                                                     True)
+                with waves.scope("multihost_sb", "route_prefetch"):
+                    _, n_dest, n_rowloc, n_pos, p_valid = _route_src(
+                        n_op, n_tb, n_ac)
+                    pr = [_exchange(x) for x in _route(
+                        n_dest, n_pos, p_valid, cap, d,
+                        [n_op.reshape(-1), n_rowloc])]
+                pf_next = (key, jnp.asarray(occ_i, I32), pr[0], pr[1])
+            else:
+                pf_next = _empty_pf()
+            # regenerate the in-flight cohort's source-side locals from
+            # its carried (key, occ) — pure replay, no collective
+            ttype, l_op, l_tb, l_ac, ts_amt = _src_cohort(
+                pf[0], pf[1], dev, True)
+            active, dest, row_loc, pos, valid = _route_src(l_op, l_tb,
+                                                           l_ac)
+            r_op, r_row = pf[2], pf[3]
+            attempted = pf[1]
+        else:
+            pf_next = None
+            ttype, l_op, l_tb, l_ac, ts_amt = _src_cohort(key, occ_i,
+                                                          dev, gen_new)
+
             if ring is not None:
-                fields.append(jnp.repeat(txn_new, L))
-            routed = [_exchange(x)
-                      for x in _route(dest, pos, valid, cap, d, fields)]
-            r_op, r_row = routed[:2]
-            r_txn = routed[2] if ring is not None else None
+                # dinttrace ids: one per generated txn, identical on
+                # every device/host that touches it (routed copies below
+                # carry it)
+                tu = jnp.asarray(t).astype(U32)
+                du = dev.astype(U32)
+                lane_w = jnp.arange(w, dtype=U32)
+                txn_new = (tu * U32(d) + du) * U32(w) + lane_w
+                txn_c1 = ((tu - U32(1)) * U32(d) + du) * U32(w) + lane_w
+
+            with waves.scope("multihost_sb", "route"):
+                active, dest, row_loc, pos, valid = _route_src(
+                    l_op, l_tb, l_ac)
+                fields = [l_op.reshape(-1), row_loc]
+                if ring is not None:
+                    fields.append(jnp.repeat(txn_new, L))
+                routed = [_exchange(x)
+                          for x in _route(dest, pos, valid, cap, d,
+                                          fields)]
+                r_op, r_row = routed[:2]
+                r_txn = routed[2] if ring is not None else None
+            if serve:
+                attempted = (jnp.asarray(occ_i, I32) if gen_new
+                             else jnp.asarray(0, I32))
+            else:
+                attempted = jnp.asarray(w if gen_new else 0, I32)
 
         # ---- owner side: no-wait S/X arbitration + fused read ---------
         lanes = jnp.arange(d * cap, dtype=I32)
@@ -269,7 +369,7 @@ def build_multihost_sb_runner(mesh: Mesh, n_accounts: int, w: int = 2048,
 
         new_ctx = SBCtx(
             acc=l_ac, tbl=l_tb, do_write=do_write, nw=nw,
-            attempted=jnp.asarray(w if gen_new else 0, I32),
+            attempted=attempted,
             committed=committed.sum(dtype=I32),
             ab_lock=(lock_rejected & (l_op[:, 0] != 0)).sum(dtype=I32),
             ab_logic=logic_abort.sum(dtype=I32),
@@ -393,6 +493,20 @@ def build_multihost_sb_runner(mesh: Mesh, n_accounts: int, w: int = 2048,
                 mon.CTR_ROUTE_DCN_LANES: dcn_lanes,
                 mon.CTR_DISPATCH_XLA: 1,
             })
+            if serve and gen_new:
+                # admission accounting at the DISPATCH step (the cohort
+                # the host just handed over), independent of arbitration
+                # timing: occupancy + padded == w x serving steps and
+                # shed mirrors the host tally in both overlap modes
+                occ32 = jnp.asarray(occ_i, I32)
+                cnt = mon.bump(cnt, {
+                    mon.CTR_SERVE_OCC_LANES: occ32,
+                    mon.CTR_SERVE_PAD_LANES: jnp.asarray(w, I32) - occ32,
+                    mon.CTR_SERVE_SHED_LANES: jnp.asarray(shed_i, I32),
+                })
+            if overlap and gen_new:
+                cnt = mon.bump(cnt, {mon.CTR_ROUTE_PREFETCH_LANES:
+                                     p_valid.sum(dtype=I32)})
             cnt = mon.gauge_max(cnt, {mon.CTR_RING_HWM: log.head.max()})
 
         if ring is not None:
@@ -439,15 +553,21 @@ def build_multihost_sb_runner(mesh: Mesh, n_accounts: int, w: int = 2048,
             lambda x: pcast_varying(x, DCN_AXIS, ICI_AXIS), new_ctx)
         stats = jax.lax.psum(
             jax.lax.psum(_stats_of(c1), ICI_AXIS), DCN_AXIS)
-        return state, new_ctx, stats, cnt, ring
+        return state, new_ctx, pf_next, stats, cnt, ring
 
-    def scan_fn(carry, key, gen_new=True):
+    def scan_fn(carry, xs, gen_new=True):
         state, c1 = carry[:2]
-        ring = carry[2] if trace_on else None
+        pf = carry[2] if overlap else None
+        ring = carry[2 + int(overlap)] if trace_on else None
         cnt = carry[-1] if monitor else None
-        state, new_ctx, stats, cnt, ring = local_step(state, c1, key, cnt,
-                                                      ring, gen_new)
-        out = ((state, new_ctx) + ((ring,) if trace_on else ())
+        if serve:
+            key, occ_i, shed_i = xs
+        else:
+            key, occ_i, shed_i = xs, None, None
+        state, new_ctx, pf, stats, cnt, ring = local_step(
+            state, c1, pf, key, occ_i, shed_i, cnt, ring, gen_new)
+        out = ((state, new_ctx) + ((pf,) if overlap else ())
+               + ((ring,) if trace_on else ())
                + ((cnt,) if monitor else ()))
         return out, stats
 
@@ -459,35 +579,57 @@ def build_multihost_sb_runner(mesh: Mesh, n_accounts: int, w: int = 2048,
 
     def _reset_ring(carry):
         if trace_on:    # each drained window is self-contained
-            carry = carry[:2] + (txe.reset(carry[2]),) + carry[3:]
+            i = 2 + int(overlap)
+            carry = carry[:i] + (txe.reset(carry[i]),) + carry[i + 1:]
         return carry
 
     def block_local(*args):
-        key = args[-1]
-        keys = jax.random.split(key, cohorts_per_block)
+        if serve:
+            key, occ, shed = args[-3], args[-2], args[-1]
+            carries = args[:-3]
+            xs = (jax.random.split(key, cohorts_per_block),
+                  sq(occ), sq(shed))
+        else:
+            key = args[-1]
+            carries = args[:-1]
+            xs = jax.random.split(key, cohorts_per_block)
         carry, stats = jax.lax.scan(
-            scan_fn, _reset_ring(tuple(sq(a) for a in args[:-1])), keys)
+            scan_fn, _reset_ring(tuple(sq(a) for a in carries)), xs)
         return tuple(unsq(x) for x in carry) + (stats,)
 
     def drain_local(*args):
         key = args[-1]
-        carry, s1 = scan_fn(_reset_ring(tuple(sq(a) for a in args[:-1])),
-                            key, gen_new=False)
+        carry = _reset_ring(tuple(sq(a) for a in args[:-1]))
+
+        def flush(carry):
+            zero = jnp.asarray(0, I32)
+            xs = (key, zero, zero) if serve else key
+            return scan_fn(carry, xs, gen_new=False)
+
+        carry, s1 = flush(carry)
+        stats = [s1]
+        if overlap:
+            # two flush steps: arbitrate the last prefetched cohort,
+            # then install it — the double buffer's extra pipeline stage
+            carry, s2 = flush(carry)
+            stats.append(s2)
         out = (unsq(carry[0]),)
         if trace_on:
-            out = out + (unsq(carry[2]),)
+            out = out + (unsq(carry[2 + int(overlap)]),)
         if monitor:
             out = out + (unsq(carry[-1]),)
-        return out + (jnp.stack([s1]),)
+        return out + (jnp.stack(stats),)
 
     grid = P(DCN_AXIS, ICI_AXIS)
-    n_carry = 2 + int(trace_on) + int(monitor)
-    spec = (grid,) * n_carry + (P(),)
-    block = jax.shard_map(block_local, mesh=mesh, in_specs=spec,
+    n_carry = 2 + int(overlap) + int(trace_on) + int(monitor)
+    spec_run = ((grid,) * n_carry + (P(),)
+                + ((grid, grid) if serve else ()))
+    spec_drain = (grid,) * n_carry + (P(),)
+    block = jax.shard_map(block_local, mesh=mesh, in_specs=spec_run,
                           out_specs=(grid,) * n_carry + (P(),))
     drain_m = jax.shard_map(
-        drain_local, mesh=mesh, in_specs=spec,
-        out_specs=(grid,) * (n_carry - 1) + (P(),))
+        drain_local, mesh=mesh, in_specs=spec_drain,
+        out_specs=(grid,) * (1 + int(trace_on) + int(monitor)) + (P(),))
     donate = tuple(range(n_carry))
     jit_block = jax.jit(block, donate_argnums=donate)
     jit_drain = jax.jit(drain_m, donate_argnums=donate)
@@ -500,13 +642,24 @@ def build_multihost_sb_runner(mesh: Mesh, n_accounts: int, w: int = 2048,
                                  (n_hosts, n_ici) + x.shape), shard),
             one)
 
-    def run(carry, key):
-        out = jit_block(*carry, key)
+    def run(carry, key, occ=None, shed=None):
+        if serve:
+            out = jit_block(*carry, key, jnp.asarray(occ, I32),
+                            jnp.asarray(shed, I32))
+        else:
+            out = jit_block(*carry, key)
         return out[:-1], out[-1]
 
     def init(state):
+        if overlap:
+            # start one step EARLY: the bootstrap step arbitrates the
+            # empty prefetch buffer (a provable no-op), so cohort j is
+            # arbitrated at step 2+j and installed at 3+j exactly as on
+            # the unoverlapped route — the bit-identity anchor
+            state = state.replace(step=state.step - 1)
         base = (state, stack_leaf(_empty_sb_ctx(w)))
         return (base
+                + ((stack_leaf(_empty_pf()),) if overlap else ())
                 + ((stack_leaf(txe.create_ring(tcfg.cap)),)
                    if trace_on else ())
                 + ((stack_leaf(mon.create()),) if monitor else ()))

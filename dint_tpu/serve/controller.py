@@ -126,11 +126,21 @@ class WidthController:
     proposed after ``hysteresis_blocks`` blocks at the current width,
     because a width switch costs a drain (flush the 3-stage pipeline)
     plus an init at the new width.
+
+    ``lanes_scale``: number of parallel serving lanesets behind ONE
+    controller — the mesh serving plane (serve/mesh.py) runs D = hosts x
+    chips cohorts of width w per step but keeps a single global
+    controller, so offered rates are observed in PER-DEVICE units
+    (inst_rate / lanes_scale) and the width policy/backlog bound stay
+    exactly the single-device functions above. 1 (the default) is the
+    round-17 single-device plane unchanged.
     """
 
-    def __init__(self, cfg: ControllerCfg, model: ServiceModel):
+    def __init__(self, cfg: ControllerCfg, model: ServiceModel,
+                 lanes_scale: int = 1):
         self.cfg = cfg
         self.model = model
+        self.lanes_scale = max(int(lanes_scale), 1)
         # EWMA state, seeded from the prior
         self.service_us = {w: model.service_us(w) for w in cfg.widths}
         self.offered_rate = 0.0
@@ -141,6 +151,7 @@ class WidthController:
         self._block_idx = 0
 
     def observe_rate(self, inst_rate: float) -> None:
+        inst_rate = inst_rate / self.lanes_scale
         a = self.cfg.rate_alpha
         self.offered_rate = ((1 - a) * self.offered_rate + a * inst_rate
                              if self.offered_rate > 0.0 else inst_rate)
@@ -176,18 +187,21 @@ class WidthController:
             "saturated": self.saturated,
             "service_us": dict(self.service_us),
             "switches": list(self.switches),
+            "lanes_scale": self.lanes_scale,
         }
 
 
 def simulate_widths(schedule: np.ndarray, cfg: ControllerCfg,
-                    model: ServiceModel, *, cohorts_per_block: int = 2
-                    ) -> list[int]:
+                    model: ServiceModel, *, cohorts_per_block: int = 2,
+                    lanes_scale: int = 1) -> list[int]:
     """Closed-form controller trajectory for an arrival schedule under a
     pure ServiceModel (no engine, no clock): the sequence of widths the
     controller would serve each block at. Used by tests and
     ``tools/dintserve.py simulate`` to show the policy before burning a
-    TPU on it. Deterministic by construction."""
-    ctl = WidthController(cfg, model)
+    TPU on it. Deterministic by construction. ``lanes_scale`` rehearses
+    the mesh plane: D devices serve each block, so the controller sees
+    per-device rates (dintserve --mesh HxC passes H*C here)."""
+    ctl = WidthController(cfg, model, lanes_scale=lanes_scale)
     widths, i, t = [], 0, 0.0
     n = len(schedule)
     while i < n:

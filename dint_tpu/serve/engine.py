@@ -95,6 +95,13 @@ def cached_runner(engine: str, size: int, *, val_words: int = 4, **kw):
     if engine == "tatp_dense":
         from ..engines import tatp_dense as td
         out = td.build_pipelined_runner(size, val_words=val_words, **kw)
+    elif engine == "multihost_sb":
+        # the mesh serving plane (serve/mesh.py): kw carries the 2-D
+        # mesh; the builder is itself memoized, this cache just keeps
+        # the lookup uniform across engine families
+        from ..parallel import multihost_sb as mhs
+        mkw = dict(kw)
+        out = mhs.build_multihost_sb_runner(mkw.pop("mesh"), size, **mkw)
     else:
         from ..engines import smallbank_dense as sd
         out = sd.build_pipelined_runner(size, **kw)
@@ -123,6 +130,10 @@ class ServeEngine:
         use_hotset, hot_frac, ...)
     """
 
+    # engine families this class can drive; subclasses (serve/mesh.py's
+    # MeshServeEngine) narrow it to their own runner-builder path
+    ENGINES: tuple[str, ...] = ("tatp_dense", "smallbank_dense")
+
     def __init__(self, engine: str, size: int, *,
                  cfg: ControllerCfg | None = None,
                  model: ServiceModel | None = None,
@@ -130,7 +141,7 @@ class ServeEngine:
                  val_words: int = 4, clock=None, monitor: bool = True,
                  seed: int = 0, idle_poll_us: float = 50_000.0,
                  runner_kw: dict | None = None):
-        assert engine in ("tatp_dense", "smallbank_dense"), engine
+        assert engine in self.ENGINES, engine
         assert depth >= 1
         self.engine = engine
         self.size = size

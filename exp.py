@@ -573,6 +573,92 @@ def sweep_serve(name, engine, size, *, window_s, open_rates, results,
                   {"load": frac, "target_rate": round(rate, 1)}))
 
 
+def sweep_serve_mesh(name, n_acc, *, window_s, open_rates, results,
+                     quick, cpb=4, depth=2, slo_us=5_000.0):
+    """dintmesh latency-vs-offered-load curve (round 18): the whole 2-D
+    (dcn x ici) mesh served as ONE open-loop plane (serve/mesh.py) —
+    per-host admission feeding one global SLO controller, width
+    switches coordinated mesh-wide at drain boundaries. Same ladder
+    protocol as sweep_serve (saturation probe anchors the rate ladder);
+    every artifact additionally carries the mesh shape, the per-host
+    admitted/shed split, and the route_prefetch counter so an overlap
+    A/B (DINT_SERVE_OVERLAP=1 flips the double-buffered route — see
+    tools/hw_mesh_serve.sh and the PERF.md round-18 decision rule)
+    diffs as two branches of the same artifact schema."""
+    import jax
+
+    from dint_tpu.parallel import multihost as mhost
+    from dint_tpu.serve import ControllerCfg, MeshServeEngine
+    from dint_tpu.serve import arrivals as arr
+
+    n_hosts, n_ici = mhost.mesh_shape_from_env()
+    if len(jax.devices()) < n_hosts * n_ici or n_hosts < 3:
+        print(f"{name}: skipped ({n_hosts}x{n_ici} mesh needs "
+              f"{n_hosts * n_ici} devices and >= 3 hosts; have "
+              f"{len(jax.devices())} devices)", flush=True)
+        return
+    overlap = os.environ.get("DINT_SERVE_OVERLAP", "0") == "1"
+    widths = (64, 256) if quick else (256, 1024, 4096)
+    max_arrivals = 50_000 if quick else 2_000_000
+
+    def make():
+        return MeshServeEngine(
+            n_acc, mesh_shape=(n_hosts, n_ici),
+            cfg=ControllerCfg(widths=widths, slo_us=slo_us),
+            cohorts_per_block=cpb, depth=depth, monitor=True, seed=0,
+            overlap=overlap)
+
+    def point(schedule_fn, extra_static):
+        def fn():
+            eng = make()
+            eng.warmup()          # compile outside the serving window
+            eng.run(schedule_fn())
+            eng.close()
+            rep = eng.snapshot()
+            p = {**eng.queue_hist.percentiles(),
+                 "hist": eng.queue_hist.to_dict()}
+            extra = dict(extra_static)
+            extra.update(
+                mode="serve_mesh", engine="multihost_sb",
+                widths=list(widths), mesh=rep["mesh"],
+                per_host=rep["per_host"],
+                offered=rep["offered"], admitted=rep["admitted"],
+                shed=rep["shed"], blocks=rep["blocks"],
+                offered_rate=round(rep["offered_rate"], 1),
+                achieved_rate=round(rep["achieved_rate"], 1),
+                slo_us=slo_us, slo_met=rep["slo_met"],
+                service={**eng.service_hist.percentiles(),
+                         "hist": eng.service_hist.to_dict()},
+                controller=rep["controller"],
+                serve_counters={
+                    k: rep["counters"].get(k, 0)
+                    for k in ("serve_occupancy_lanes", "serve_padded_lanes",
+                              "serve_shed_lanes",
+                              "route_prefetch_lanes")})
+            return _metric_json(rep["attempted"], rep["committed"],
+                                rep["elapsed_s"], p, extra)
+
+        return fn
+
+    # saturation probe across the whole mesh: every arrival at t=0
+    n_probe = min(widths[-1] * cpb * n_hosts * n_ici * 8, max_arrivals)
+    nm = f"{name}_sat"
+    run_point(results, nm,
+              point(lambda: np.zeros(n_probe), {"load": "sat"}))
+    blk = results.get(nm) or {}
+    peak = blk.get("achieved_rate")   # MetricBlock flattens extra
+    if not peak:
+        return
+
+    for frac in open_rates:
+        rate = max(peak * frac, 1.0)
+        win = min(window_s, max_arrivals / rate)
+        run_point(
+            results, f"{name}_r{int(frac * 100)}pct",
+            point(lambda r=rate, w=win: arr.poisson_schedule(r, w, seed=11),
+                  {"load": frac, "target_rate": round(rate, 1)}))
+
+
 def _timed_client(client, go, window_s):
     go()                             # compile
     client.rec.reset()
@@ -1172,7 +1258,11 @@ def run_all(out: str, window_s: float = 10.0, quick: bool = False,
                                           else float(hot_prob)),
                              "use_hotset": pg.resolve_use_hotset(None)},
                 geom={"l": sd.L, "vw": sd.VW})
-    if want("serve"):
+    # --only serve_mesh is a preset (like skew): the bidirectional
+    # substring filter would also fire the single-device serve legs
+    # ("serve" in "serve_mesh"), so the mesh preset suppresses them
+    mesh_preset = only is not None and "mesh" in only
+    if want("serve") and not mesh_preset:
         # always-on serving plane (dint_tpu/serve): open-loop
         # latency-vs-offered-load curves with exact queue/service
         # attribution; RealClock, so rates/latencies are wall-measured
@@ -1182,6 +1272,12 @@ def run_all(out: str, window_s: float = 10.0, quick: bool = False,
         sweep_serve("serve_smallbank", "smallbank_dense", n_acc,
                     window_s=window_s, open_rates=rates, results=results,
                     quick=quick, cpb=cpb)
+    if want("serve_mesh") and not skew_preset:
+        # mesh-wide serving plane (serve/mesh.py): the whole 2-D mesh
+        # as one open-loop service; self-gates on device count/hosts
+        sweep_serve_mesh("serve_mesh", n_acc, window_s=window_s,
+                         open_rates=rates, results=results, quick=quick,
+                         cpb=cpb)
 
     sweep_micro(window_s, quick, results, want=want)  # self-gates per point
 

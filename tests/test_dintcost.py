@@ -406,3 +406,56 @@ def test_hier_dominance_finding_fires_when_hier_regresses(monkeypatch):
     fs = cb._hier_dominance_findings(SimpleNamespace(name=name), model)
     assert [f.code for f in fs] == ["hier-dcn-dominance"]
     assert fs[0].severity == "error" and fs[0].site == name
+
+
+# --------------------------------------- double-buffered route (round 18)
+
+
+def test_overlap_twins_parity_and_priced_footprint_everywhere():
+    """Round-18 tentpole, statically: at every calibrated overlap pair
+    the double-buffered serve route moves NO MORE dcn-axis link bytes
+    per step than the unoverlapped twin it is supposed to hide under,
+    and its footprint exceeds the twin's by exactly the priced prefetch
+    double buffer (targets.OVERLAP_FOOTPRINT) — the in-flight cohort is
+    the ONLY extra state the overlap may hold."""
+    pairs = 0
+    for name, twin in sorted(T.TARGET_OVERLAP_TWIN.items()):
+        mo, mt = cost.model_for(name), cost.model_for(twin)
+        assert not mo.error and not mt.error, (name, mo.error, mt.error)
+        assert mo.dcn_bytes_per_step <= mt.dcn_bytes_per_step, \
+            (name, mo.dcn_bytes_per_step, twin, mt.dcn_bytes_per_step)
+        allowance = cost.eval_budget_bytes(T.OVERLAP_FOOTPRINT,
+                                           mo.geom, 0.0)
+        assert allowance and allowance > 0, (name, mo.geom)
+        extra = mo.footprint_bytes - mt.footprint_bytes
+        assert 0 < extra <= allowance, (name, extra, allowance)
+        pairs += 1
+    assert pairs >= 2         # serve@overlap, serve@overlap+mon
+
+
+def test_overlap_findings_fire_on_regression(monkeypatch):
+    """Liveness for the round-18 overlap gates: (a) pointing a target
+    whose route moves MORE dcn bytes at a cheaper twin must fire
+    overlap-dcn-parity; (b) a target carrying state past the priced
+    double buffer must fire overlap-footprint. Both name the twin."""
+    from types import SimpleNamespace
+
+    from dint_tpu.analysis.passes import cost_budget as cb
+
+    # (a) the flat serve lowering moves MORE dcn bytes than the
+    # hierarchical serve target — parity must fire
+    name = "multihost_sb/serve@flat"
+    model = cost.model_for(name)
+    monkeypatch.setitem(T.TARGET_OVERLAP_TWIN, name, "multihost_sb/serve")
+    fs = cb._overlap_findings(SimpleNamespace(name=name), model)
+    assert "overlap-dcn-parity" in [f.code for f in fs]
+    assert all(f.severity == "error" and f.site == "multihost_sb/serve"
+               for f in fs)
+
+    # (b) the trace variant carries event-ring state far past the
+    # priced prefetch buffer — footprint must fire
+    name2 = "multihost_sb/block@trace"
+    model2 = cost.model_for(name2)
+    monkeypatch.setitem(T.TARGET_OVERLAP_TWIN, name2, "multihost_sb/block")
+    fs2 = cb._overlap_findings(SimpleNamespace(name=name2), model2)
+    assert "overlap-footprint" in [f.code for f in fs2]

@@ -337,6 +337,63 @@ def test_serve_counters_reconcile_and_mirror():
         == base["serve_shed_lanes"] == 0
 
 
+def test_mesh_serve_counters_reconcile_and_prefetch_ledger():
+    """Round-18 mesh lane ledger: on the 2-D serve-mode runner the
+    occupancy identity holds ACROSS the mesh (occ + padded == width x
+    serving-steps x devices), the per-host shed mirror reconciles
+    host<->device, and the overlap route accounts every prefetched lane:
+    route_prefetch_lanes == lock_requests when the double buffer is on,
+    0 when it is off — with the per-axis route split identity intact in
+    both modes."""
+    from dint_tpu.parallel import multihost_sb as mh
+
+    # geometry matches tests/test_dintmesh.py's engines exactly so the
+    # process-wide builder memo shares both compiled runners (tier-1
+    # wall-clock: this test pays runs, not compiles)
+    H, C, BLK, Wm, Nm = 4, 2, 2, 16, 256
+    mesh = mh.make_mesh_2d(H, C)
+    rng = np.random.default_rng(3)
+    occs = [rng.integers(0, Wm + 1, size=(H, C, BLK)).astype(np.int32)
+            for _ in range(BLK)]
+    sheds = [rng.integers(0, 4, size=(H, C, BLK)).astype(np.int32)
+             for _ in range(BLK)]
+
+    snaps = {}
+    for overlap in (False, True):
+        run, init, drain = mh.build_multihost_sb_runner(
+            mesh, Nm, w=Wm, cohorts_per_block=BLK, monitor=True,
+            serve=True, overlap=overlap)
+        carry = init(mh.create_multihost_sb(mesh, Nm))
+        for i, (o, sh) in enumerate(zip(occs, sheds)):
+            carry, _ = run(carry, jax.random.fold_in(KEY(5), i), o, sh)
+        _, _, cnt = drain(carry)
+        snaps[overlap] = M.snapshot(cnt)
+
+    n_occ = sum(int(o.sum()) for o in occs)
+    steps = len(occs) * BLK                      # serving steps only
+    for overlap, snap in snaps.items():
+        assert snap["serve_occupancy_lanes"] == n_occ, overlap
+        assert snap["serve_occupancy_lanes"] + snap["serve_padded_lanes"] \
+            == steps * Wm * H * C, overlap       # mesh-wide identity
+        # host<->device shed mirror: the device ledger equals the sum of
+        # the per-host tallies the host pushed through the occ/shed slots
+        assert snap["serve_shed_lanes"] == sum(int(s.sum()) for s in sheds)
+        assert snap["txn_attempted"] == n_occ, overlap
+        # per-axis route split survives the double buffer
+        assert snap["route_ici_lanes"] + snap["route_dcn_lanes"] == \
+            snap["lock_requests"] + snap["install_writes"], overlap
+
+    # the prefetch ledger: every valid lock-request lane was exchanged
+    # one step early under overlap; the unoverlapped route never touches
+    # the counter
+    assert snaps[False]["route_prefetch_lanes"] == 0
+    assert snaps[True]["route_prefetch_lanes"] == \
+        snaps[True]["lock_requests"] > 0
+    # scheduling must not change WHAT was locked/committed
+    for k in ("lock_requests", "txn_committed", "install_writes"):
+        assert snaps[False][k] == snaps[True][k], k
+
+
 # ------------------------------------------------------- generic engines
 
 

@@ -167,6 +167,22 @@ def test_diff_detects_injected_wave_regression(tmp_path):
     assert attrib.diff_breakdowns(a, b, wave_pct=100.0, step_pct=50.0)["ok"]
 
 
+def test_diff_names_overlap_route_prefetch_regression(tmp_path):
+    """Round 18: a regression in the double-buffered mesh exchange — the
+    wave that must stay HIDDEN under cohort i's owner waves — is named
+    by diff. tools/hw_mesh_serve.sh's overlap A/B stage gates on exactly
+    this: overlap that stops overlapping fails loudly, by name."""
+    pert = str(tmp_path / "pert.json")
+    attrib.synthesize_trace(
+        pert, steps=4, scale={"dint.multihost_sb.route_prefetch": 2.0})
+    a = attrib.report(FIXTURE, geometry=GEOM)
+    b = attrib.report(pert, geometry=GEOM)
+    d = attrib.diff_breakdowns(a, b)
+    assert not d["ok"]
+    assert any(r.get("wave") == "dint.multihost_sb.route_prefetch"
+               for r in d["regressions"])
+
+
 def test_diff_ignores_sub_noise_waves():
     a = attrib.report(FIXTURE, geometry=GEOM)
     b = json.loads(json.dumps(a))

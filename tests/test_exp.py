@@ -2,6 +2,8 @@
 import json
 import os
 
+import pytest
+
 import exp
 
 
@@ -52,3 +54,30 @@ def test_quick_tatp_sweep(tmp_path):
     op = next(v for k, v in results.items() if k.startswith("tatp_open_"))
     assert op["mode"] == "open"
     assert op["target_rate"] > 0 and op["offered_rate"] > 0
+
+
+@pytest.mark.slow
+def test_quick_serve_mesh_sweep(tmp_path):
+    """--only serve_mesh is a preset: it drives the mesh serving plane
+    ladder (saturation probe + rate points with mesh/per-host extras)
+    and SUPPRESSES the single-device serve legs the bidirectional
+    substring filter would otherwise fire."""
+    out = str(tmp_path / "res")
+    results = exp.run_all(out, window_s=0.3, quick=True, only="serve_mesh")
+
+    names = sorted(results)
+    assert "serve_mesh_sat" in names
+    assert not any(n.startswith(("serve_tatp", "serve_smallbank"))
+                   for n in names), names
+    blk = results["serve_mesh_sat"]
+    assert "error" not in blk, blk
+    assert blk["mesh"]["n_hosts"] >= 3 and blk["mesh"]["n_ici"] >= 1
+    assert blk["offered"] == blk["admitted"] + blk["shed"]
+    assert sum(h["admitted"] for h in blk["per_host"]) == blk["admitted"]
+    sc = blk["serve_counters"]
+    assert sc["serve_occupancy_lanes"] == blk["admitted"]
+    assert "route_prefetch_lanes" in sc
+    assert blk["controller"]["lanes_scale"] == \
+        blk["mesh"]["n_hosts"] * blk["mesh"]["n_ici"]
+    # the ladder ran past the anchor
+    assert any(n.startswith("serve_mesh_r") for n in names)

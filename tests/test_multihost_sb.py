@@ -164,3 +164,92 @@ def test_mesh_shape_from_env(monkeypatch):
     monkeypatch.setenv("DINT_BENCH_MESH", "banana")
     with pytest.raises(ValueError, match="DINT_BENCH_MESH"):
         mhost.mesh_shape_from_env()
+
+
+# ------------------------------------------- mesh serving plane (round 18)
+
+
+def test_serve_full_occupancy_replays_closed_loop():
+    """serve=True at occ == w is the closed loop: same stats every block
+    AND through the drain, same final state tree — the occupancy mask
+    and the serve counter plumbing cost nothing when every lane is
+    live."""
+    mesh = mh.make_mesh_2d(H, C)
+    run_c, init_c, drain_c = mh.build_multihost_sb_runner(
+        mesh, N, w=W, cohorts_per_block=BLK)
+    run_s, init_s, drain_s = mh.build_multihost_sb_runner(
+        mesh, N, w=W, cohorts_per_block=BLK, serve=True)
+    cc = init_c(mh.create_multihost_sb(mesh, N))
+    cs = init_s(mh.create_multihost_sb(mesh, N))
+    key = jax.random.PRNGKey(7)
+    full = np.full((H, C, BLK), W, np.int32)
+    zero = np.zeros((H, C, BLK), np.int32)
+    for i in range(BLK):
+        k = jax.random.fold_in(key, i)
+        cc, s1 = run_c(cc, k)
+        cs, s2 = run_s(cs, k, full, zero)
+        assert np.array_equal(np.asarray(s1), np.asarray(s2)), i
+    st1, t1 = drain_c(cc)
+    st2, t2 = drain_s(cs)
+    assert np.array_equal(np.asarray(t1), np.asarray(t2))
+    for a, b in zip(jax.tree_util.tree_leaves(st1),
+                    jax.tree_util.tree_leaves(st2)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_overlap_route_bit_identical_to_unoverlapped():
+    """The round-18 pin: the double-buffered route (cohort i+1's
+    exchange issued under cohort i's owner waves) is a SCHEDULING
+    change, not a semantic one. Same key stream, same (random, partial)
+    occupancies => the ENTIRE final state tree — balances, backups,
+    stamps, log rings — is bit-identical to the unoverlapped serve
+    route, and the run+drain stat totals agree. Per-block stats shift by
+    one step (cohort j arbitrates at t+1 under overlap), so only the
+    totals are comparable."""
+    mesh = mh.make_mesh_2d(H, C)
+    run_s, init_s, drain_s = mh.build_multihost_sb_runner(
+        mesh, N, w=W, cohorts_per_block=BLK, serve=True)
+    run_o, init_o, drain_o = mh.build_multihost_sb_runner(
+        mesh, N, w=W, cohorts_per_block=BLK, serve=True, overlap=True)
+    cs = init_s(mh.create_multihost_sb(mesh, N))
+    co = init_o(mh.create_multihost_sb(mesh, N))
+    key = jax.random.PRNGKey(11)
+    rng = np.random.default_rng(42)
+    zero = np.zeros((H, C, BLK), np.int32)
+    tot_s = np.zeros(dsb.N_STATS, np.int64)
+    tot_o = np.zeros(dsb.N_STATS, np.int64)
+    for i in range(BLK):
+        k = jax.random.fold_in(key, i)
+        occ = rng.integers(0, W + 1, size=(H, C, BLK)).astype(np.int32)
+        cs, s1 = run_s(cs, k, occ, zero)
+        co, s2 = run_o(co, k, occ, zero)
+        tot_s += np.asarray(s1, np.int64).sum(axis=0)
+        tot_o += np.asarray(s2, np.int64).sum(axis=0)
+    st_s, t_s = drain_s(cs)
+    st_o, t_o = drain_o(co)
+    tot_s += np.asarray(t_s, np.int64).sum(axis=0)
+    tot_o += np.asarray(t_o, np.int64).sum(axis=0)
+    assert np.array_equal(tot_s, tot_o), (tot_s, tot_o)
+    leaves_s = jax.tree_util.tree_leaves(st_s)
+    leaves_o = jax.tree_util.tree_leaves(st_o)
+    assert len(leaves_s) == len(leaves_o)
+    for a, b in zip(leaves_s, leaves_o):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    # the masked lanes really were masked: fewer attempts than the
+    # closed loop would have made, and the accounting still closes
+    attempted = int(tot_s[dsb.STAT_ATTEMPTED])
+    assert attempted < BLK * BLK * W * D
+    assert attempted == int(tot_s[dsb.STAT_COMMITTED]) \
+        + int(tot_s[dsb.STAT_AB_LOCK]) + int(tot_s[dsb.STAT_AB_LOGIC])
+
+
+def test_overlap_and_serve_guards():
+    """overlap is a property of the SERVING route; trace widens the
+    route slots the prefetch replays. Both misuses must refuse loudly at
+    build time, not degrade."""
+    mesh = mh.make_mesh_2d(H, C)
+    with pytest.raises(ValueError, match="serve=True"):
+        mh.build_multihost_sb_runner(mesh, N, w=W, overlap=True)
+    with pytest.raises(ValueError, match="trace"):
+        mh.build_multihost_sb_runner(mesh, N, w=W, serve=True,
+                                     overlap=True, trace=True)

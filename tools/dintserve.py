@@ -8,6 +8,11 @@ run       serve one open-loop arrival schedule end to end and print the
           split, shed count, width trajectory, SLO verdict). --virtual
           runs under the deterministic VirtualClock + ServiceModel (CPU
           policy rehearsal); the default RealClock measures wall time.
+          --mesh HxC serves over the whole 2-D (dcn x ici) mesh instead
+          (serve/mesh.py: per-host admission, one global controller,
+          mesh-coordinated width switches); add --overlap for the
+          double-buffered route. Exit-gate semantics are unchanged:
+          0 when the SLO is met (or --no-gate), 1 otherwise.
 simulate  controller-only rehearsal: the width trajectory the SLO
           controller would take for a schedule under the service-time
           prior — no engine, no device, milliseconds. Use it to sanity-
@@ -21,6 +26,8 @@ Examples
       --rate 50000 --window 2 --widths 256,1024,8192 --slo-us 5000
   python tools/dintserve.py simulate --rate 200000 --window 1 \\
       --widths 256,1024,4096,8192 --slo-us 2000
+  python tools/dintserve.py run --mesh 4x2 --size 100000 --rate 400000 \\
+      --window 0.1 --widths 256,1024 --virtual
   python tools/dintserve.py describe
 """
 from __future__ import annotations
@@ -47,16 +54,34 @@ def _schedule(args):
                              seed=args.seed, **kw)
 
 
+def _mesh_shape(s: str) -> tuple[int, int]:
+    import re
+    m = re.fullmatch(r"(\d+)\s*[xX*]\s*(\d+)", s.strip())
+    if not m:
+        raise SystemExit(f"--mesh wants HxC (e.g. 4x2), got {s!r}")
+    return int(m.group(1)), int(m.group(2))
+
+
 def cmd_run(args) -> int:
-    from dint_tpu.serve import (ControllerCfg, ServeEngine, ServiceModel,
-                                VirtualClock)
+    from dint_tpu.serve import (ControllerCfg, MeshServeEngine, ServeEngine,
+                                ServiceModel, VirtualClock)
     cfg = ControllerCfg(widths=_widths(args.widths), slo_us=args.slo_us)
     model = ServiceModel(base_us=args.model_base_us,
                          per_lane_ns=args.model_per_lane_ns)
-    eng = ServeEngine(args.engine, args.size, cfg=cfg, model=model,
-                      cohorts_per_block=args.cpb, depth=args.depth,
-                      clock=VirtualClock() if args.virtual else None,
-                      monitor=not args.no_monitor, seed=args.seed)
+    clock = VirtualClock() if args.virtual else None
+    if args.mesh:
+        eng = MeshServeEngine(args.size, mesh_shape=_mesh_shape(args.mesh),
+                              cfg=cfg, model=model,
+                              cohorts_per_block=args.cpb, depth=args.depth,
+                              clock=clock, monitor=not args.no_monitor,
+                              seed=args.seed, overlap=args.overlap)
+        label = f"mesh {args.mesh} multihost_sb"
+    else:
+        eng = ServeEngine(args.engine, args.size, cfg=cfg, model=model,
+                          cohorts_per_block=args.cpb, depth=args.depth,
+                          clock=clock, monitor=not args.no_monitor,
+                          seed=args.seed)
+        label = args.engine
     if not args.virtual:
         eng.warmup()          # compile outside the serving window
     eng.run(_schedule(args))
@@ -64,8 +89,8 @@ def cmd_run(args) -> int:
     rep = eng.snapshot()
     if args.json:
         print(json.dumps(rep))
-        return 0
-    print(f"dintserve {args.engine} size={args.size} "
+        return 0 if rep["slo_met"] or args.no_gate else 1
+    print(f"dintserve {label} size={args.size} "
           f"widths={list(cfg.widths)} slo={cfg.slo_us:.0f}us "
           f"{'virtual' if args.virtual else 'real'} clock")
     print(f"  offered  {rep['offered']} arrivals "
@@ -88,6 +113,13 @@ def cmd_run(args) -> int:
         print(f"  lanes    occupancy={c.get('serve_occupancy_lanes', 0)} "
               f"padded={c.get('serve_padded_lanes', 0)} "
               f"shed={c.get('serve_shed_lanes', 0)}")
+    if "mesh" in rep:
+        m = rep["mesh"]
+        print(f"  mesh     {m['n_hosts']}x{m['n_ici']} "
+              f"hierarchical={m['hierarchical']} overlap={m['overlap']}")
+        for hrep in rep["per_host"]:
+            print(f"    host {hrep['host']}: admitted={hrep['admitted']} "
+                  f"shed={hrep['shed']}")
     return 0 if rep["slo_met"] or args.no_gate else 1
 
 
@@ -96,11 +128,15 @@ def cmd_simulate(args) -> int:
     cfg = ControllerCfg(widths=_widths(args.widths), slo_us=args.slo_us)
     model = ServiceModel(base_us=args.model_base_us,
                          per_lane_ns=args.model_per_lane_ns)
+    shape = _mesh_shape(args.mesh) if args.mesh else None
     widths = simulate_widths(_schedule(args), cfg, model,
-                             cohorts_per_block=args.cpb)
+                             cohorts_per_block=args.cpb,
+                             lanes_scale=shape[0] * shape[1] if shape
+                             else 1)
     out = {"widths": sorted(set(widths)), "blocks": len(widths),
            "trajectory": widths if args.json else None,
-           "final_width": widths[-1] if widths else None}
+           "final_width": widths[-1] if widths else None,
+           "mesh": list(shape) if shape else None}
     if args.json:
         print(json.dumps(out))
         return 0
@@ -130,9 +166,12 @@ def cmd_describe(args) -> int:
     for n in mon.ALL_NAMES:
         if n.startswith("serve_"):
             print(f"  {n:24s} {mon.COUNTER_DOCS[n].splitlines()[0]}")
-    print("serve waves (dintscope; compute-only, no bytes formula):")
-    for eng in ("tatp_dense", "smallbank_dense"):
-        nm = waves.full_name(eng, "serve")
+    print("serve waves (dintscope; the mesh route_prefetch wave prices "
+          "the double-buffered exchange):")
+    for eng, wv in (("tatp_dense", "serve"), ("smallbank_dense", "serve"),
+                    ("multihost_sb", "serve"),
+                    ("multihost_sb", "route_prefetch")):
+        nm = waves.full_name(eng, wv)
         print(f"  {nm}: {waves.WAVE_DOCS[nm].splitlines()[0]}")
     print("serve targets (dintlint/dintcost/dintdur gated):")
     for n in sorted(tg.TARGETS):
@@ -169,9 +208,17 @@ def main() -> int:
         p.add_argument("--model-base-us", type=float, default=150.0)
         p.add_argument("--model-per-lane-ns", type=float, default=40.0)
         p.add_argument("--json", action="store_true")
+        p.add_argument("--mesh", default=None, metavar="HxC",
+                       help="serve over the whole 2-D mesh (e.g. 4x2): "
+                            "run drives serve/mesh.py's MeshServeEngine, "
+                            "simulate rehearses per-device rates "
+                            "(lanes_scale = H*C)")
         if engine:
             p.add_argument("--engine", default="tatp_dense",
                            choices=("tatp_dense", "smallbank_dense"))
+            p.add_argument("--overlap", action="store_true",
+                           help="mesh only: serve through the double-"
+                                "buffered route (PERF.md round 18)")
             p.add_argument("--size", type=int, default=100_000,
                            help="n_sub / n_accounts")
             p.add_argument("--depth", type=int, default=2,
