@@ -149,8 +149,18 @@ def _child_main():
     # must never void a measurement (ISSUE 1 acceptance)
     from dint_tpu.ops import pallas_gather as pg
 
-    use_pallas = pg.resolve_use_pallas(None, n_idx=2 * WIDTH * td.K,
-                                       m_lock=2 * WIDTH, k_arb=td.K_ARB)
+    # plan-resolved knobs replace the env-flag default path (ISSUE 17):
+    # the pinned PLAN.json decides use_pallas / use_hotset / use_fused for
+    # the headline config; ambient DINT_* flags win only under
+    # DINT_PLAN_OVERRIDE=1 and the artifact records which knobs the
+    # override changed. Without a readable plan, behaviour is exactly the
+    # old env resolution and the artifact records "plan": null.
+    plan_knobs, plan_meta = _plan_resolve("tatp_uniform")
+    use_pallas = pg.resolve_use_pallas(
+        plan_knobs.get("use_pallas") if plan_meta else None,
+        n_idx=2 * WIDTH * td.K, m_lock=2 * WIDTH, k_arb=td.K_ARB)
+    plan_kw = {k: plan_knobs[k] for k in ("use_hotset", "use_fused")
+               if k in plan_knobs} if plan_meta else {}
 
     def build_and_warm(use_pallas):
         t0 = _time.time()
@@ -162,7 +172,8 @@ def _child_main():
         run, init, drain = td.build_pipelined_runner(
             N_SUBSCRIBERS, w=WIDTH, val_words=VAL_WORDS,
             cohorts_per_block=BLOCK, check_magic=check_magic,
-            use_pallas=use_pallas, monitor=monitor_on, trace=trace_on)
+            use_pallas=use_pallas, monitor=monitor_on, trace=trace_on,
+            **plan_kw)
         carry = init(db)
         populate_s = _time.time() - t0
 
@@ -396,6 +407,12 @@ def _child_main():
         "use_hotset": pg.env_use_hotset(),
         "hot_frac": HOT_FRAC,
         "hot_prob": HOT_PROB,
+        # which pinned plan resolved the build knobs, schema-stable:
+        # {source, hash, overridden} when PLAN.json was readable (dintplan,
+        # ANALYSIS.md "Static configuration planning"), EXPLICIT null
+        # otherwise — an artifact can always prove whether its knobs were
+        # plan-resolved or ambient
+        "plan": plan_meta,
         # end-of-run dintmon snapshot, schema-stable: a {name: count}
         # object when DINT_MONITOR=1, EXPLICIT null otherwise — consumers
         # never need to distinguish "off" from "old artifact schema"
@@ -467,6 +484,27 @@ def _child_main():
         except Exception as e:  # secondary metric must not kill the headline
             out["smallbank_error"] = repr(e)[:200]
     print(json.dumps(out), flush=True)
+
+
+def _plan_resolve(workload):
+    """Plan-resolved build knobs for one workload from the pinned
+    PLAN.json (analysis/plan.resolve_for): the plan replaces the env-flag
+    default path, and ambient DINT_* flags win only under
+    DINT_PLAN_OVERRIDE=1 (meta["overridden"] records which knobs moved —
+    the plan_check gate makes any other contradiction an ERROR). Returns
+    ({}, None) when no plan is readable or DINT_BENCH_PLAN=0: knobs then
+    fall back to plain env resolution and the artifact records
+    "plan": null, never a silent default."""
+    if os.environ.get("DINT_BENCH_PLAN", "1") == "0":
+        return {}, None
+    try:
+        from dint_tpu.analysis import plan as dplan
+        knobs, meta = dplan.resolve_for(workload)
+        if meta.get("source") is None:
+            return {}, None
+        return knobs, meta
+    except Exception:  # noqa: BLE001 — a broken plan must not kill bench
+        return {}, None
 
 
 def _dintlint_snapshot():
@@ -566,14 +604,19 @@ def _bench_smallbank():
     # quoted; the headline is the abort-matched point (bench_smallbank.run)
     env_w = os.environ.get("DINT_BENCH_SB_WIDTH")
     widths = (int(env_w),) if env_w else bench_smallbank.WIDTHS
-    return bench_smallbank.run(
+    sb_knobs, sb_meta = _plan_resolve("smallbank_skewed")
+    out = bench_smallbank.run(
         window_s=WINDOW_S,
         n_accounts=int(os.environ.get("DINT_BENCH_SB_ACCOUNTS",
                                       bench_smallbank.N_ACCOUNTS)),
         widths=widths,
         block=BLOCK,
         hot_frac=HOT_FRAC,
-        hot_prob=HOT_PROB)
+        hot_prob=HOT_PROB,
+        knobs={k: v for k, v in sb_knobs.items()
+               if k.startswith("use_")} if sb_meta else None)
+    out["smallbank_plan"] = sb_meta
+    return out
 
 
 ARTIFACT_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),

@@ -75,6 +75,22 @@ def prune_entries(entries: list[dict]) -> tuple[list[dict], list[dict]]:
     return kept, dropped
 
 
+def prune_scoped(entries: list[dict], pass_name: str
+                 ) -> tuple[list[dict], list[dict]]:
+    """Gate-scoped prune (dintcost/dintdur/dintplan --prune-allowlist):
+    split (kept, dropped) considering ONLY entries pinned to
+    ``pass_name``. Callers must have run apply() over that gate's FULL
+    target matrix first. Entries for other passes — and wildcard-pass
+    ("*") entries, whose findings may live in gates this run never
+    traced — are always kept; dropping them is dintlint
+    --prune-allowlist's job (the full-suite run)."""
+    dropped = [e for e in entries
+               if e["pass"] == pass_name and not e.get("_used")]
+    drop_ids = {id(e) for e in dropped}
+    kept = [e for e in entries if id(e) not in drop_ids]
+    return kept, dropped
+
+
 def save(path: str, entries: list[dict]) -> None:
     """Rewrite an allowlist file (private `_`-prefixed bookkeeping keys
     stripped), one entry per line like the hand-maintained original."""

@@ -20,9 +20,14 @@ walking machinery and ANALYSIS.md for the invariant catalogue):
                      fault domains, bounded rings, replay coverage,
                      in-doubt totality (analysis/dataflow.py's LOGGED/
                      TRUNCATED facts — the dintdur gate)
+  plan_check         the pinned PLAN.json agrees with the knob registry,
+                     the calibration ledger and the dintcost-derived
+                     frontier; env flags cannot contradict it silently
+                     (analysis/plan.py — the dintplan gate)
 
 Adding a pass: write `passes/<name>.py`, decorate the entry point with
 `@core.register_pass("<name>")`, import it here.
 """
-from . import (aliasing, cost_budget, durability, protocol,  # noqa: F401
-               purity, scatter_race, shard_consistency, u64_overflow)
+from . import (aliasing, cost_budget, durability, plan_check,  # noqa: F401
+               protocol, purity, scatter_race, shard_consistency,
+               u64_overflow)

@@ -34,14 +34,18 @@ WIDTHS = (8192, 16384)
 
 def run(window_s: float = 10.0, n_accounts: int = N_ACCOUNTS,
         widths=WIDTHS, block: int = BLOCK, hot_frac: float | None = None,
-        hot_prob: float | None = None) -> dict:
+        hot_prob: float | None = None,
+        knobs: dict | None = None) -> dict:
     """Bench every width in ``widths``; headline the abort-matched point
     and quote all (width, tps, abort_rate) points.
 
     ``hot_frac``/``hot_prob`` override the workload's 90%/4% skew (the
-    bench.py --hot-frac/--hot-prob knobs); the dintcache hot tier follows
-    DINT_USE_HOTSET (the builder aligns its mirror to hot_frac)."""
-    points = [_run_one(window_s, n_accounts, w, block, hot_frac, hot_prob)
+    bench.py --hot-frac/--hot-prob knobs). ``knobs`` carries the
+    plan-resolved builder knobs (use_pallas / use_hotset / use_fused —
+    bench.py's _plan_resolve); None falls back to the builder's env
+    resolution (DINT_USE_HOTSET etc.)."""
+    points = [_run_one(window_s, n_accounts, w, block, hot_frac, hot_prob,
+                       knobs)
               for w in widths]
     head = min(points, key=lambda p: p["abort_rate"])
     return {
@@ -58,7 +62,8 @@ def run(window_s: float = 10.0, n_accounts: int = N_ACCOUNTS,
 
 def _run_one(window_s: float, n_accounts: int, width: int, block: int,
              hot_frac: float | None = None,
-             hot_prob: float | None = None) -> dict:
+             hot_prob: float | None = None,
+             knobs: dict | None = None) -> dict:
     from ..ops import pallas_gather as pg
     from . import workloads as wl
 
@@ -66,7 +71,7 @@ def _run_one(window_s: float, n_accounts: int, width: int, block: int,
     base = int(np.asarray(sd.total_balance(db)))
     runner, init, drain = sd.build_pipelined_runner(
         n_accounts, w=width, cohorts_per_block=block, hot_frac=hot_frac,
-        hot_prob=hot_prob)
+        hot_prob=hot_prob, **(knobs or {}))
     carry = init(db)
     key = jax.random.PRNGKey(1)
 
@@ -102,8 +107,11 @@ def _run_one(window_s: float, n_accounts: int, width: int, block: int,
         "committed_tps": round(committed / dt, 1),
         "abort_rate": round(1 - committed / max(attempted, 1), 5),
         # skew + hot-tier provenance: A/B artifacts must be
-        # distinguishable (same rule as bench.py's "use_pallas")
-        "use_hotset": pg.resolve_use_hotset(None),
+        # distinguishable (same rule as bench.py's "use_pallas"); a
+        # plan-resolved knob records the value that actually built
+        "use_hotset": (knobs["use_hotset"]
+                       if knobs and "use_hotset" in knobs
+                       else pg.resolve_use_hotset(None)),
         "hot_frac": wl.SB_HOT_FRAC if hot_frac is None else float(hot_frac),
         "hot_prob": wl.SB_HOT_PROB if hot_prob is None else float(hot_prob),
     }

@@ -14,16 +14,21 @@ dict) fall back to an uncached build rather than failing.
 from __future__ import annotations
 
 import functools
-import os
 
-# builders resolve None-valued knobs from these at BUILD time
-# (ops/pallas_gather.resolve_use_*, monitor/txnevents trace defaults),
-# so the ambient values are part of the compiled program's identity —
-# fold a snapshot into the key or a monkeypatched env would hit a
-# stale entry
-_ENV_KNOBS = ("DINT_USE_PALLAS", "DINT_USE_FUSED", "DINT_USE_HOTSET",
-              "DINT_PALLAS_INTERPRET", "DINT_TRACE", "DINT_TRACE_RATE",
-              "DINT_TRACE_CAP")
+# builders resolve None-valued knobs from the ambient environment at
+# BUILD time (ops/pallas_gather.resolve_use_*, monitor/txnevents trace
+# defaults), so those values are part of the compiled program's
+# identity — fold a snapshot into the key or a monkeypatched env would
+# hit a stale entry. The snapshot is analysis/plan.env_knob_signature():
+# the CANONICALIZED resolution of every build-identity knob, from the
+# same single resolver the builders and the plan checker use — unset,
+# "" and "0" (all False to a builder) share one memo entry, and the
+# memo key can never disagree with the builder about what a flag means.
+
+
+def _env_signature() -> tuple:
+    from ..analysis import plan           # deferred: engines must import
+    return plan.env_knob_signature()      # without the analysis package
 
 
 def memoize_builder(fn):
@@ -31,7 +36,7 @@ def memoize_builder(fn):
 
     @functools.wraps(fn)
     def wrapped(*args, **kw):
-        env = tuple(os.environ.get(k) for k in _ENV_KNOBS)
+        env = _env_signature()
         try:
             key = (args, tuple(sorted(kw.items())), env)
             hit = cache.get(key)         # hashing happens here too (ndarray

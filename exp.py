@@ -75,6 +75,53 @@ def _trace_on() -> bool:
     return os.environ.get("DINT_TRACE") == "1"
 
 
+# plan consumption (ISSUE 17): the pinned PLAN.json replaces the env-flag
+# default path for the sweep's build knobs; ambient DINT_* flags win only
+# under DINT_PLAN_OVERRIDE=1 (which the per-workload meta records). One
+# load per process; _PLAN_OVERRIDDEN accumulates the union of knobs the
+# override actually changed so every point artifact can carry it.
+_PLAN_DOC: list | None = None
+_PLAN_OVERRIDDEN: set = set()
+
+
+def _plan_doc():
+    global _PLAN_DOC
+    if _PLAN_DOC is None:
+        doc = None
+        if os.environ.get("DINT_BENCH_PLAN", "1") != "0":
+            try:
+                from dint_tpu.analysis import plan as dplan
+                doc = dplan.load_plan()
+            except Exception:  # noqa: BLE001 — sweep must not die on a
+                doc = None     # missing/corrupt plan; points record null
+        _PLAN_DOC = [doc]
+    return _PLAN_DOC[0]
+
+
+def _plan_knobs(workload: str) -> dict:
+    """Plan-resolved build knobs for one workload ({} without a readable
+    plan — the builders then env-resolve exactly as before)."""
+    doc = _plan_doc()
+    if doc is None:
+        return {}
+    from dint_tpu.analysis import plan as dplan
+    knobs, meta = dplan.resolve_for(workload, plan=doc)
+    _PLAN_OVERRIDDEN.update(meta["overridden"])
+    return knobs
+
+
+def _plan_meta():
+    """The artifact's "plan" field: {source, hash, overridden} when the
+    sweep resolved knobs from a pinned plan, EXPLICIT None otherwise."""
+    doc = _plan_doc()
+    if doc is None:
+        return None
+    from dint_tpu.analysis import plan as dplan
+    return {"source": str(dplan.plan_path()),
+            "hash": doc.get("provenance", {}).get("cost_model_hash"),
+            "overridden": sorted(_PLAN_OVERRIDDEN)}
+
+
 def _drain(drain, carry):
     """Drain a runner under the current flags. Runners return
     (state, stats) + ((ring,) if DINT_TRACE) + ((counters,) if
@@ -231,8 +278,11 @@ def _tatp_runner(n_sub, w, cpb, seed=0):
     from dint_tpu.engines import tatp_dense as td
     from dint_tpu.ops import pallas_gather as pg
 
-    use_pallas = pg.resolve_use_pallas(None, n_idx=2 * w * td.K,
+    knobs = _plan_knobs("tatp_uniform")
+    use_pallas = pg.resolve_use_pallas(knobs.get("use_pallas"),
+                                       n_idx=2 * w * td.K,
                                        m_lock=2 * w, k_arb=td.K_ARB)
+    kb = {k: knobs[k] for k in ("use_hotset", "use_fused") if k in knobs}
 
     def build(up):
         # on-device populate: the full sweep runs at the reference's 7M
@@ -241,7 +291,7 @@ def _tatp_runner(n_sub, w, cpb, seed=0):
                                 val_words=10)
         run, init, drain = td.build_pipelined_runner(
             n_sub, w=w, val_words=10, cohorts_per_block=cpb, use_pallas=up,
-            monitor=_monitor_on(), trace=_trace_on())
+            monitor=_monitor_on(), trace=_trace_on(), **kb)
         run = _wrap_trace(run, init)
         carry = init(db)
         if up:
@@ -282,14 +332,17 @@ def _sb_runner(n_acc, w, cpb, hot_frac=None, hot_prob=None):
     from dint_tpu.engines import smallbank_dense as sd
     from dint_tpu.ops import pallas_gather as pg
 
-    use_pallas = pg.resolve_use_pallas(None, n_idx=w * sd.L, m_lock=None)
+    knobs = _plan_knobs("smallbank_skewed")
+    use_pallas = pg.resolve_use_pallas(knobs.get("use_pallas"),
+                                       n_idx=w * sd.L, m_lock=None)
+    kb = {k: knobs[k] for k in ("use_hotset", "use_fused") if k in knobs}
 
     def build(up):
         db = sd.create(n_acc)
         run, init, drain = sd.build_pipelined_runner(
             n_acc, w=w, cohorts_per_block=cpb, use_pallas=up,
             hot_frac=hot_frac, hot_prob=hot_prob,
-            monitor=_monitor_on(), trace=_trace_on())
+            monitor=_monitor_on(), trace=_trace_on(), **kb)
         run = _wrap_trace(run, init)
         carry = init(db)
         if up:
@@ -357,7 +410,13 @@ def run_point(results, name, fn, attempts=2, backoff_s=30):
         if attempt:
             time.sleep(backoff_s)
         try:
-            results[name] = fn()
+            out = fn()
+            if isinstance(out, dict):
+                # artifact provenance: which pinned plan resolved the
+                # build knobs (object or EXPLICIT null — same consumer
+                # contract as counters/breakdown)
+                out.setdefault("plan", _plan_meta())
+            results[name] = out
             return True
         except Exception as e:      # noqa: BLE001 - record-and-continue
             err = repr(e)[:300]
@@ -1194,7 +1253,10 @@ def run_all(out: str, window_s: float = 10.0, quick: bool = False,
                          else float(hot_frac)),
             "hot_prob": (wl.SB_HOT_PROB if hot_prob is None
                          else float(hot_prob)),
-            "use_hotset": pg.resolve_use_hotset(None),
+            # the value that actually built: plan-pinned when a plan is
+            # readable, env-resolved otherwise (matches _sb_runner)
+            "use_hotset": _plan_knobs("smallbank_skewed").get(
+                "use_hotset", pg.resolve_use_hotset(None)),
         }
         sweep_pipeline("smallbank",
                        lambda w, b: _sb_runner(n_acc, w, b, hot_frac,
@@ -1256,7 +1318,10 @@ def run_all(out: str, window_s: float = 10.0, quick: bool = False,
                 point_extra={"hot_frac": frac,
                              "hot_prob": (0.9 if hot_prob is None
                                           else float(hot_prob)),
-                             "use_hotset": pg.resolve_use_hotset(None)},
+                             "use_hotset": _plan_knobs(
+                                 "smallbank_skewed").get(
+                                 "use_hotset",
+                                 pg.resolve_use_hotset(None))},
                 geom={"l": sd.L, "vw": sd.VW})
     # --only serve_mesh is a preset (like skew): the bidirectional
     # substring filter would also fire the single-device serve legs

@@ -459,3 +459,51 @@ def test_overlap_findings_fire_on_regression(monkeypatch):
     monkeypatch.setitem(T.TARGET_OVERLAP_TWIN, name2, "multihost_sb/block")
     fs2 = cb._overlap_findings(SimpleNamespace(name=name2), model2)
     assert "overlap-footprint" in [f.code for f in fs2]
+
+
+def test_prune_check_is_a_gate_scoped_dry_run(tmp_path, capsys):
+    """The stale-entry contract, shared verbatim with dintlint and
+    dintdur: `check --prune-allowlist --check` is a DRY RUN that fails
+    (exit 1) on a stale cost_budget entry without touching the file;
+    without --check the stale entry is dropped — but ONLY entries
+    scoped to this gate's pass. Wildcard-pass entries and entries for
+    other passes belong to dintlint's full-suite prune and survive."""
+    main = _dintcost_main()
+    entries = json.loads(
+        open(os.path.join(REPO, "tools", "dintlint_allow.json")).read())
+    n_repo = len(entries)
+    entries += [
+        {"pass": "cost_budget", "code": "no-such-code",
+         "reason": "stale on purpose"},
+        {"pass": "*", "code": "no-such-code",
+         "reason": "wildcard: only dintlint may judge this"},
+    ]
+    path = tmp_path / "allow.json"
+    path.write_text(json.dumps(entries))
+    before = path.read_text()
+
+    # dry run: exit 1, file NOT rewritten, offender named
+    assert main(["check", "--prune-allowlist", "--check",
+                 "--allowlist", str(path)]) == 1
+    assert path.read_text() == before
+    out = capsys.readouterr().out
+    assert "NOT rewritten" in out
+    assert "cost_budget/no-such-code" in out
+
+    # real prune: exit 0, ONLY the gate-scoped stale entry dropped
+    assert main(["check", "--prune-allowlist",
+                 "--allowlist", str(path)]) == 0
+    capsys.readouterr()
+    pruned = json.loads(path.read_text())
+    assert len(pruned) == n_repo + 1
+    assert not any(e["pass"] == "cost_budget" for e in pruned)
+    assert any(e["pass"] == "*" and e["code"] == "no-such-code"
+               for e in pruned)          # dintlint's problem, kept
+
+    # usage discipline: --check only modifies --prune-allowlist, and
+    # the prune needs the gate's full matrix (no --target)
+    with pytest.raises(SystemExit):
+        main(["check", "--all", "--check"])
+    with pytest.raises(SystemExit):
+        main(["check", "--prune-allowlist", "--target",
+              "tatp_dense/block", "--allowlist", str(path)])

@@ -567,3 +567,56 @@ def test_dintlint_sarif_export(tmp_path):
     sarif = json.loads(sarif_path.read_text())
     assert sarif["version"] == "2.1.0"
     assert sarif["runs"][0]["tool"]["driver"]["name"] == "dintlint"
+
+
+def _dintdur_main():
+    # main() runs in-process (same importlib pattern as the dintcost
+    # prune test) so the CLI reuses this process's TraceCache
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "_dintdur_cli", os.path.join(REPO, "tools", "dintdur.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.main
+
+
+def test_prune_check_is_a_gate_scoped_dry_run(tmp_path, capsys):
+    """Same stale-entry contract as dintlint/dintcost, scoped to the
+    durability pass: dry run fails without rewriting; the real prune
+    drops ONLY the stale durability entry — the repo's still-matching
+    durability suppression, wildcard-pass entries and other passes'
+    entries all survive."""
+    main = _dintdur_main()
+    entries = json.loads(
+        open(os.path.join(REPO, "tools", "dintlint_allow.json")).read())
+    n_repo = len(entries)
+    # the repo allowlist carries a REAL durability suppression — the
+    # prune must keep it (its finding still fires)
+    assert any(e["pass"] == "durability" for e in entries)
+    entries.append({"pass": "durability", "code": "no-such-code",
+                    "reason": "stale on purpose"})
+    path = tmp_path / "allow.json"
+    path.write_text(json.dumps(entries))
+    before = path.read_text()
+
+    assert main(["check", "--prune-allowlist", "--check",
+                 "--allowlist", str(path)]) == 1
+    assert path.read_text() == before
+    out = capsys.readouterr().out
+    assert "NOT rewritten" in out
+    assert "durability/no-such-code" in out
+
+    assert main(["check", "--prune-allowlist",
+                 "--allowlist", str(path)]) == 0
+    capsys.readouterr()
+    pruned = json.loads(path.read_text())
+    assert len(pruned) == n_repo
+    assert not any(e["code"] == "no-such-code" for e in pruned)
+    assert any(e["pass"] == "durability"
+               and e["code"] == "no-ring-truncation" for e in pruned)
+    assert any(e["pass"] == "scatter_race" for e in pruned)
+
+    with pytest.raises(SystemExit):      # --check without the prune
+        main(["check", "--all", "--check"])
+    with pytest.raises(SystemExit):      # prune is check-mode only
+        main(["report", "--all", "--prune-allowlist"])

@@ -538,6 +538,11 @@ def _broken_findings(pname):
         # rest of the dintdur fixtures
         import test_dintdur
         return test_dintdur.broken_wal_order_findings()
+    if pname == "plan_check":
+        # the canonical broken plan fixture (swapped frontier ranks =>
+        # flipped-ordering) lives with the rest of the dintplan fixtures
+        import test_dintplan
+        return test_dintplan.broken_plan_findings()
     raise AssertionError(pname)
 
 

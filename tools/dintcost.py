@@ -15,6 +15,8 @@ Usage:
     python tools/dintcost.py check --target tatp_dense/block@fused
         [--allowlist tools/dintlint_allow.json] [--json]
     python tools/dintcost.py check --all --sarif out.sarif  # SARIF 2.1.0
+    python tools/dintcost.py check --prune-allowlist     # drop stale
+    python tools/dintcost.py check --prune-allowlist --check  # dry-run
     python tools/dintcost.py diff A.json B.json [--bytes-pct 10] [--json]
     python tools/dintcost.py describe [--json]           # budget ledger
 
@@ -49,6 +51,7 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 from dint_tpu import analysis  # noqa: E402
+from dint_tpu.analysis import allowlist as al  # noqa: E402
 from dint_tpu.analysis import cost  # noqa: E402
 from dint_tpu.analysis import targets as T  # noqa: E402
 
@@ -59,7 +62,8 @@ DEFAULT_ALLOWLIST = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 # embed the report payload and the hw_round scripts archive it
 # schema 2: per-axis link bytes (ici_bytes_per_step / dcn_bytes_per_step
 # at top level and per wave) for the 2-D mesh targets
-JSON_SCHEMA = 2
+# schema 3: check payload carries stale_allowlist (--prune-allowlist)
+JSON_SCHEMA = 3
 
 DEFAULT_BYTES_PCT = 10.0
 
@@ -166,14 +170,55 @@ def cmd_report(args, ap) -> int:
 
 
 def cmd_check(args, ap) -> int:
-    names = _target_names(args, ap)
+    if args.check and not args.prune_allowlist:
+        ap.error("--check only modifies --prune-allowlist (dry-run)")
     allowlist = args.allowlist
     if allowlist is None and os.path.exists(DEFAULT_ALLOWLIST):
         allowlist = DEFAULT_ALLOWLIST
-    findings = analysis.run(targets=None if args.all else names,
-                            passes=["cost_budget"],
-                            allowlist_path=allowlist)
-    failed = analysis.has_errors(findings)
+    stale = False
+    if args.prune_allowlist:
+        # gate-scoped prune: the full target matrix under ONLY this
+        # gate's pass; only cost_budget entries can be judged stale here
+        # (wildcard-pass entries belong to dintlint --prune-allowlist)
+        if args.target:
+            ap.error("--prune-allowlist needs the gate's full matrix: "
+                     "stale-entry detection over a subset run would drop "
+                     "entries whose findings simply were not traced "
+                     "(drop --target)")
+        if not allowlist or not os.path.exists(allowlist):
+            ap.error("--prune-allowlist: no allowlist file found "
+                     f"(looked for {allowlist or DEFAULT_ALLOWLIST})")
+        names = sorted(T.TARGETS)
+        entries = al.load(allowlist)
+        findings = analysis.run(passes=["cost_budget"],
+                                allowlist_entries=entries)
+        kept, dropped = al.prune_scoped(entries, "cost_budget")
+        if dropped:
+            if args.check:
+                stale = True
+                print(f"{allowlist}: {len(dropped)} stale entr"
+                      f"{'y' if len(dropped) == 1 else 'ies'} "
+                      f"({len(kept)} kept) — file NOT rewritten "
+                      "(--check); run --prune-allowlist to fix:")
+            else:
+                al.save(allowlist, kept)
+                print(f"pruned {len(dropped)} stale entr"
+                      f"{'y' if len(dropped) == 1 else 'ies'} from "
+                      f"{allowlist} ({len(kept)} kept):")
+            for e in dropped:
+                print(f"  - {e['pass']}/{e['code']} "
+                      f"(target={e.get('target', '*')})")
+        else:
+            n_scoped = sum(e["pass"] == "cost_budget" for e in entries)
+            print(f"{allowlist}: all {n_scoped} cost_budget entr"
+                  f"{'y' if n_scoped == 1 else 'ies'} still match — "
+                  "nothing to prune")
+    else:
+        names = _target_names(args, ap)
+        findings = analysis.run(targets=None if args.all else names,
+                                passes=["cost_budget"],
+                                allowlist_path=allowlist)
+    failed = analysis.has_errors(findings) or stale
     if args.sarif:
         sarif = json.dumps(analysis.to_sarif(findings, ap.prog), indent=1)
         if args.sarif == "-":
@@ -189,6 +234,7 @@ def cmd_check(args, ap) -> int:
             "n_errors": sum(f.severity == "error" and not f.suppressed
                             for f in findings),
             "n_suppressed": sum(f.suppressed for f in findings),
+            "stale_allowlist": stale,
             "ok": not failed,
             "findings": [f.to_dict() for f in findings]}), flush=True)
     else:
@@ -313,6 +359,14 @@ def main(argv=None) -> int:
     p.add_argument("--sarif", metavar="PATH", default=None,
                    help="also write the findings as SARIF 2.1.0 "
                         "('-' for stdout) — same exporter dintlint uses")
+    p.add_argument("--prune-allowlist", action="store_true",
+                   help="run this gate's full matrix, then rewrite the "
+                        "allowlist dropping cost_budget entries that "
+                        "matched no finding (other gates' entries and "
+                        "wildcard-pass entries are kept)")
+    p.add_argument("--check", action="store_true",
+                   help="with --prune-allowlist: dry-run — rewrite "
+                        "nothing, exit 1 if stale entries exist")
     p.add_argument("--json", action="store_true")
     p.set_defaults(fn=cmd_check)
 

@@ -15,6 +15,8 @@ documented in ANALYSIS.md "Durability facts & passes".
 Usage:
     python tools/dintdur.py check --all                  # the CI gate
     python tools/dintdur.py check --target tatp_dense/block
+    python tools/dintdur.py check --prune-allowlist      # drop stale entries
+    python tools/dintdur.py check --prune-allowlist --check   # dry-run gate
     python tools/dintdur.py report --all                 # findings, no gate
     python tools/dintdur.py report --all --json          # one JSON line
     python tools/dintdur.py report --all --sarif out.sarif
@@ -51,13 +53,15 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 from dint_tpu import analysis  # noqa: E402
+from dint_tpu.analysis import allowlist as al  # noqa: E402
 from dint_tpu.analysis.passes import durability as _dur  # noqa: E402
 
 DEFAULT_ALLOWLIST = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                  "dintlint_allow.json")
 
 # bumped when keys of the --json payload change shape
-JSON_SCHEMA = 1
+# schema 2: check payload carries stale_allowlist (--prune-allowlist)
+JSON_SCHEMA = 2
 
 _CHECKS = {
     "wal-order":
@@ -111,6 +115,15 @@ def main(argv=None) -> int:
     ap.add_argument("--allowlist", default=None,
                     help="allowlist JSON path (default: the shared "
                          "tools/dintlint_allow.json when present)")
+    ap.add_argument("--prune-allowlist", action="store_true",
+                    help="check mode only: run the durability pass over "
+                         "the FULL target matrix and rewrite the "
+                         "allowlist dropping this gate's stale entries "
+                         "(entries for other passes and wildcard-pass "
+                         "entries are kept — dintlint prunes those)")
+    ap.add_argument("--check", action="store_true",
+                    help="with --prune-allowlist: dry-run — report stale "
+                         "entries and exit 1 without rewriting the file")
     args = ap.parse_args(argv)
 
     if args.mode == "describe":
@@ -138,7 +151,11 @@ def main(argv=None) -> int:
             print(f"  {name:32s} [{proto}]")
         return 0
 
-    if not args.all and not args.target:
+    if args.check and not args.prune_allowlist:
+        ap.error("--check only modifies --prune-allowlist (dry-run)")
+    if args.prune_allowlist and args.mode != "check":
+        ap.error("--prune-allowlist is a check-mode operation")
+    if not args.all and not args.target and not args.prune_allowlist:
         ap.error("pick targets with --target/--all")
     bad = [n for n in args.target if n not in analysis.TARGETS]
     if bad:
@@ -151,12 +168,52 @@ def main(argv=None) -> int:
     if allowlist is None and os.path.exists(DEFAULT_ALLOWLIST):
         allowlist = DEFAULT_ALLOWLIST
 
-    findings = analysis.run(
-        targets=None if args.all else args.target,
-        passes=["durability"],
-        allowlist_path=allowlist)
+    stale = False
+    if args.prune_allowlist:
+        # gate-scoped prune: the full target matrix under ONLY the
+        # durability pass; only durability entries can be judged stale
+        # here (wildcard-pass entries belong to dintlint
+        # --prune-allowlist, the full-suite run)
+        if args.target:
+            ap.error("--prune-allowlist needs the gate's full matrix: "
+                     "stale-entry detection over a subset run would drop "
+                     "entries whose findings simply were not traced "
+                     "(drop --target)")
+        if not allowlist or not os.path.exists(allowlist):
+            ap.error("--prune-allowlist: no allowlist file found "
+                     f"(looked for {allowlist or DEFAULT_ALLOWLIST})")
+        entries = al.load(allowlist)
+        findings = analysis.run(passes=["durability"],
+                                allowlist_entries=entries)
+        kept, dropped = al.prune_scoped(entries, "durability")
+        if dropped:
+            if args.check:
+                stale = True
+                print(f"{allowlist}: {len(dropped)} stale entr"
+                      f"{'y' if len(dropped) == 1 else 'ies'} "
+                      f"({len(kept)} kept) — file NOT rewritten "
+                      "(--check); run --prune-allowlist to fix:")
+            else:
+                al.save(allowlist, kept)
+                print(f"pruned {len(dropped)} stale entr"
+                      f"{'y' if len(dropped) == 1 else 'ies'} from "
+                      f"{allowlist} ({len(kept)} kept):")
+            for e in dropped:
+                print(f"  - {e['pass']}/{e['code']} "
+                      f"(target={e.get('target', '*')})")
+        else:
+            n_scoped = sum(e["pass"] == "durability" for e in entries)
+            print(f"{allowlist}: all {n_scoped} durability entr"
+                  f"{'y' if n_scoped == 1 else 'ies'} still match — "
+                  "nothing to prune")
+    else:
+        findings = analysis.run(
+            targets=None if args.all else args.target,
+            passes=["durability"],
+            allowlist_path=allowlist)
 
-    failed = args.mode == "check" and analysis.has_errors(findings)
+    failed = (args.mode == "check"
+              and (analysis.has_errors(findings) or stale))
     if args.sarif:
         sarif = json.dumps(analysis.to_sarif(findings, ap.prog), indent=1)
         if args.sarif == "-":
@@ -172,6 +229,7 @@ def main(argv=None) -> int:
             "targets": (sorted(analysis.TARGETS) if args.all
                         else args.target),
             "allowlist": allowlist,
+            "stale_allowlist": stale,
             "n_findings": len(findings),
             "n_errors": sum(f.severity == "error" and not f.suppressed
                             for f in findings),

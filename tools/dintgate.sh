@@ -1,0 +1,91 @@
+#!/usr/bin/env bash
+# dintgate: ONE entry point for all five standing static gates.
+#
+#   tools/dintgate.sh [--quick] [--sarif PATH]
+#
+# Gates, in dependency-free order:
+#   1. dintlint --all          every analysis pass over every target
+#                              (plan_check rides along in STATIC form)
+#   2. dintcost check --all    the priced budget/parity/overlap gate
+#   3. dintdur  check --all    the durability/replication gate
+#   4. dintplan check          the FULL planner gate (re-derives every
+#                              frontier price; --quick keeps it static)
+#   5. dintmon  check          the counter-identity gate on the pinned
+#                              fixture artifact (no trace run needed)
+#
+# --sarif PATH merges the four finding gates' SARIF logs into one
+# multi-run SARIF 2.1.0 document (one runs[] entry per gate driver) —
+# upload-ready for code-scanning UIs. dintmon is a numeric identity
+# check, not a findings pass, so it reports via exit code only.
+#
+# Exit 0 iff EVERY gate passed; each failing gate is named. All gates
+# always run (no fail-fast) so one invocation reports the full damage.
+set -u
+cd "$(dirname "$0")/.."
+
+QUICK=0
+SARIF=""
+while [ $# -gt 0 ]; do
+    case "$1" in
+        --quick) QUICK=1 ;;
+        --sarif) shift; SARIF="${1:?--sarif needs a path}" ;;
+        -h|--help)
+            sed -n '2,22p' "$0" | sed 's/^# \{0,1\}//'
+            exit 0 ;;
+        *) echo "dintgate: unknown argument: $1 (try --help)" >&2; exit 2 ;;
+    esac
+    shift
+done
+
+PY="${PYTHON:-python}"
+TMP="$(mktemp -d)"
+trap 'rm -rf "$TMP"' EXIT
+
+PLAN_ARGS=""
+[ "$QUICK" = 1 ] && PLAN_ARGS="--static"
+
+FAIL=""
+run_gate() {
+    name="$1"; shift
+    echo "=== $name: $*"
+    if "$@"; then
+        echo "--- $name: ok"
+    else
+        echo "--- $name: FAIL (exit $?)"
+        FAIL="$FAIL $name"
+    fi
+}
+
+run_gate dintlint "$PY" tools/dintlint.py --all --sarif "$TMP/lint.sarif"
+run_gate dintcost "$PY" tools/dintcost.py check --all --sarif "$TMP/cost.sarif"
+run_gate dintdur  "$PY" tools/dintdur.py check --all --sarif "$TMP/dur.sarif"
+run_gate dintplan "$PY" tools/dintplan.py check $PLAN_ARGS --sarif "$TMP/plan.sarif"
+run_gate dintmon  "$PY" tools/dintmon.py check tests/fixtures/dintmon_counters.json
+
+if [ -n "$SARIF" ]; then
+    "$PY" - "$SARIF" "$TMP"/*.sarif <<'MERGE'
+import json
+import sys
+
+out, paths = sys.argv[1], sys.argv[2:]
+runs = []
+for p in paths:
+    try:
+        runs.extend(json.load(open(p)).get("runs", []))
+    except (OSError, ValueError) as e:       # a gate died pre-export
+        print(f"dintgate: skipping unreadable {p}: {e}", file=sys.stderr)
+doc = {"$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+       "version": "2.1.0", "runs": runs}
+with open(out, "w") as fh:
+    json.dump(doc, fh, indent=1)
+    fh.write("\n")
+print(f"dintgate: merged SARIF ({len(runs)} runs) -> {out}")
+MERGE
+fi
+
+if [ -z "$FAIL" ]; then
+    echo "dintgate: all 5 gates ok"
+    exit 0
+fi
+echo "dintgate: FAIL —$FAIL"
+exit 1

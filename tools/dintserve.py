@@ -62,26 +62,51 @@ def _mesh_shape(s: str) -> tuple[int, int]:
     return int(m.group(1)), int(m.group(2))
 
 
+def _plan_arg(spec: str):
+    """--plan auto|off|PATH -> the ServeEngine plan parameter."""
+    if spec == "auto":
+        return "auto"
+    if spec == "off":
+        return None
+    with open(spec) as fh:
+        return json.load(fh)
+
+
 def cmd_run(args) -> int:
     from dint_tpu.serve import (ControllerCfg, MeshServeEngine, ServeEngine,
                                 ServiceModel, VirtualClock)
-    cfg = ControllerCfg(widths=_widths(args.widths), slo_us=args.slo_us)
-    model = ServiceModel(base_us=args.model_base_us,
-                         per_lane_ns=args.model_per_lane_ns)
+    # flags still win; left at their defaults (None), the width menu /
+    # SLO / service prior resolve from the pinned plan's serve priors
+    # inside ServeEngine (and fall back to the historical defaults when
+    # no plan is readable)
+    cfg = model = None
+    if args.widths is not None or args.slo_us is not None:
+        cfg = ControllerCfg(
+            widths=_widths(args.widths or "256,1024,4096,8192"),
+            slo_us=args.slo_us if args.slo_us is not None else 5_000.0)
+    if args.model_base_us is not None or args.model_per_lane_ns is not None:
+        model = ServiceModel(
+            base_us=args.model_base_us if args.model_base_us is not None
+            else 150.0,
+            per_lane_ns=args.model_per_lane_ns
+            if args.model_per_lane_ns is not None else 40.0)
+    plan = _plan_arg(args.plan)
     clock = VirtualClock() if args.virtual else None
     if args.mesh:
         eng = MeshServeEngine(args.size, mesh_shape=_mesh_shape(args.mesh),
                               cfg=cfg, model=model,
                               cohorts_per_block=args.cpb, depth=args.depth,
                               clock=clock, monitor=not args.no_monitor,
-                              seed=args.seed, overlap=args.overlap)
+                              seed=args.seed, overlap=args.overlap,
+                              plan=plan)
         label = f"mesh {args.mesh} multihost_sb"
     else:
         eng = ServeEngine(args.engine, args.size, cfg=cfg, model=model,
                           cohorts_per_block=args.cpb, depth=args.depth,
                           clock=clock, monitor=not args.no_monitor,
-                          seed=args.seed)
+                          seed=args.seed, plan=plan)
         label = args.engine
+    cfg = eng.cfg
     if not args.virtual:
         eng.warmup()          # compile outside the serving window
     eng.run(_schedule(args))
@@ -108,6 +133,13 @@ def cmd_run(args) -> int:
     ctl = rep["controller"]
     print(f"  width    final={ctl['width']} switches={ctl['switches']} "
           f"saturated={ctl['saturated']}")
+    pl = rep.get("plan")
+    if pl:
+        over = (" env-overridden: " + ",".join(pl["overridden"])
+                if pl["overridden"] else "")
+        print(f"  plan     {pl['source']} (cost_model {pl['hash']}){over}")
+    else:
+        print("  plan     (none)")
     c = rep["counters"]
     if c:
         print(f"  lanes    occupancy={c.get('serve_occupancy_lanes', 0)} "
@@ -125,9 +157,14 @@ def cmd_run(args) -> int:
 
 def cmd_simulate(args) -> int:
     from dint_tpu.serve import ControllerCfg, ServiceModel, simulate_widths
-    cfg = ControllerCfg(widths=_widths(args.widths), slo_us=args.slo_us)
-    model = ServiceModel(base_us=args.model_base_us,
-                         per_lane_ns=args.model_per_lane_ns)
+    cfg = ControllerCfg(
+        widths=_widths(args.widths or "256,1024,4096,8192"),
+        slo_us=args.slo_us if args.slo_us is not None else 5_000.0)
+    model = ServiceModel(
+        base_us=args.model_base_us if args.model_base_us is not None
+        else 150.0,
+        per_lane_ns=args.model_per_lane_ns
+        if args.model_per_lane_ns is not None else 40.0)
     shape = _mesh_shape(args.mesh) if args.mesh else None
     widths = simulate_widths(_schedule(args), cfg, model,
                              cohorts_per_block=args.cpb,
@@ -200,13 +237,15 @@ def main() -> int:
                        choices=("poisson", "constant", "burst"))
         p.add_argument("--burst-lanes", type=int, default=4096)
         p.add_argument("--burst-every-s", type=float, default=0.01)
-        p.add_argument("--widths", default="256,1024,4096,8192")
-        p.add_argument("--slo-us", type=float, default=5_000.0)
+        p.add_argument("--widths", default=None,
+                       help="width menu (default: the pinned plan's "
+                            "serve priors, else 256,1024,4096,8192)")
+        p.add_argument("--slo-us", type=float, default=None)
         p.add_argument("--cpb", type=int, default=4,
                        help="cohorts per dispatched block")
         p.add_argument("--seed", type=int, default=0)
-        p.add_argument("--model-base-us", type=float, default=150.0)
-        p.add_argument("--model-per-lane-ns", type=float, default=40.0)
+        p.add_argument("--model-base-us", type=float, default=None)
+        p.add_argument("--model-per-lane-ns", type=float, default=None)
         p.add_argument("--json", action="store_true")
         p.add_argument("--mesh", default=None, metavar="HxC",
                        help="serve over the whole 2-D mesh (e.g. 4x2): "
@@ -216,9 +255,17 @@ def main() -> int:
         if engine:
             p.add_argument("--engine", default="tatp_dense",
                            choices=("tatp_dense", "smallbank_dense"))
-            p.add_argument("--overlap", action="store_true",
+            p.add_argument("--overlap", action="store_true", default=None,
                            help="mesh only: serve through the double-"
-                                "buffered route (PERF.md round 18)")
+                                "buffered route (PERF.md round 18); "
+                                "unset = the pinned plan's choice")
+            p.add_argument("--plan", default="auto", metavar="auto|off|PATH",
+                           help="PLAN.json consumption: 'auto' (default) "
+                                "reads the pinned plan, 'off' disables it "
+                                "(the report records \"plan\": null), a "
+                                "path reads that plan file; DINT_* env "
+                                "flags beat the plan only under "
+                                "DINT_PLAN_OVERRIDE=1")
             p.add_argument("--size", type=int, default=100_000,
                            help="n_sub / n_accounts")
             p.add_argument("--depth", type=int, default=2,
