@@ -355,7 +355,7 @@ def _child_main():
             serve_out = {k: rep[k] for k in
                          ("offered", "admitted", "shed", "blocks",
                           "achieved_rate", "slo_us", "slo_met",
-                          "queue", "service")}
+                          "queue", "service", "controller", "plan")}
         except Exception as e:  # noqa: BLE001
             serve_out = {"error": repr(e)[:200]}
 

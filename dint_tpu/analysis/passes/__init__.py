@@ -24,10 +24,15 @@ walking machinery and ANALYSIS.md for the invariant catalogue):
                      the calibration ledger and the dintcost-derived
                      frontier; env flags cannot contradict it silently
                      (analysis/plan.py — the dintplan gate)
+  calib_check        the pinned CALIB.json reproduces its own fit from
+                     the embedded samples, its provenance hashes hold,
+                     and the plan's serve rows were priced with the
+                     model the resolver picks now (monitor/calib.py —
+                     the dintcal gate)
 
 Adding a pass: write `passes/<name>.py`, decorate the entry point with
 `@core.register_pass("<name>")`, import it here.
 """
-from . import (aliasing, cost_budget, durability, plan_check,  # noqa: F401
-               protocol, purity, scatter_race, shard_consistency,
-               u64_overflow)
+from . import (aliasing, calib_check, cost_budget,  # noqa: F401
+               durability, plan_check, protocol, purity, scatter_race,
+               shard_consistency, u64_overflow)

@@ -446,11 +446,17 @@ def rank_rows(rows: list[dict]) -> None:
 def serve_priors(workload: Workload) -> dict:
     """ServiceModel capacity priors for a serve workload: the width menu
     with per-width service time, capacity and admissible backlog, the
-    knee, and the hot_frac prior the engine rebuilds toward."""
-    from ..serve.controller import (ControllerCfg, ServiceModel,
-                                    max_backlog)
+    knee, and the hot_frac prior the engine rebuilds toward. The model
+    comes from THE resolver (monitor/calib.resolve_service_model):
+    pinned CALIB.json coefficients when present, ServiceModel defaults
+    otherwise — and the row records which (source + hash), so a plan's
+    capacity claims are attributable to their coefficient source
+    (ISSUE 18 fix: this used to instantiate ServiceModel()
+    unconditionally)."""
+    from ..monitor.calib import resolve_service_model
+    from ..serve.controller import ControllerCfg, max_backlog
     cfg = ControllerCfg()
-    model = ServiceModel()
+    model, model_meta = resolve_service_model()
     widths = {}
     best_cap, knee = -1.0, cfg.widths[-1]
     for w in cfg.widths:
@@ -474,7 +480,9 @@ def serve_priors(workload: Workload) -> dict:
         "lanes_scale": workload.lanes_scale,
         "hot_frac": hot_frac,
         "model": {"base_us": model.base_us,
-                  "per_lane_ns": model.per_lane_ns},
+                  "per_lane_ns": model.per_lane_ns,
+                  "source": model_meta["source"],
+                  "hash": model_meta["hash"]},
     }
 
 

@@ -29,12 +29,34 @@ shed (newest first — the oldest waiters are closest to their deadline
 and shedding them buys nothing). Shed lanes are counted host-side AND
 mirrored into the device counter ledger (serve_shed_lanes), the same
 two-sided audit trail dinttrace uses for trace_dropped.
+
+Decision journal (dintcal, round 19): every control decision — width
+re-evaluation, admission shed, hot_frac evaluation — is appended to
+``WidthController.journal`` as a schema-stable entry carrying the exact
+inputs the pure policy functions above consumed (offered-rate EWMA,
+per-width service estimates, backlog bound) next to the recorded
+outcome. Because the policy functions are pure and the inputs are
+recorded, `tools/dintcal.py audit` can replay any journal through
+choose_width / max_backlog / recommend_hot_frac and verify every
+decision bit-for-bit; under a VirtualClock the journal itself is a
+deterministic function of (schedule, seed). monitor/calib.py ingests
+journals and the controller's (width, service_us) sample ledger as
+calibration evidence.
 """
 from __future__ import annotations
 
 import dataclasses
 
 import numpy as np
+
+# bumped when the journal header/entry shapes change; dintcal's audit
+# refuses journals it does not understand rather than mis-replaying them
+JOURNAL_SCHEMA = 1
+
+# keep-first cap on the (width, service_us) fit-sample ledger: 2-param
+# least squares saturates long before this, and keep-first (never
+# reservoir) preserves VirtualClock determinism
+SAMPLE_CAP = 512
 
 
 @dataclasses.dataclass(frozen=True)
@@ -149,6 +171,12 @@ class WidthController:
         self.saturated = False
         self.switches: list[tuple[int, int]] = []   # (block_idx, new_width)
         self._block_idx = 0
+        # dintcal: the decision journal (schema-stable dict entries) and
+        # the (width, service_us) fit-sample ledger — JSON-native types
+        # only, appended in program order, never mutated after append
+        self.journal: list[dict] = []
+        self.samples: list[list] = []               # [[width, service_us]]
+        self.samples_seen = 0
 
     def observe_rate(self, inst_rate: float) -> None:
         inst_rate = inst_rate / self.lanes_scale
@@ -160,16 +188,28 @@ class WidthController:
         a = self.cfg.service_alpha
         self.service_us[width] = ((1 - a) * self.service_us[width]
                                   + a * service_us)
+        self.samples_seen += 1
+        if len(self.samples) < SAMPLE_CAP:
+            self.samples.append([int(width), float(service_us)])
         self._block_idx += 1
         self._blocks_at_cur += 1
 
     def width(self) -> int:
         """Current serving width; re-evaluates the policy when the
-        hysteresis window has elapsed."""
+        hysteresis window has elapsed. Every re-evaluation is journaled
+        with the exact choose_width inputs so dintcal can replay it."""
         if self._blocks_at_cur >= self.cfg.hysteresis_blocks \
                 or self._block_idx == 0:
             want, sat = choose_width(self.offered_rate, self.service_us,
                                      self.cfg)
+            self.journal.append({
+                "kind": "width", "block": int(self._block_idx),
+                "inputs": {
+                    "offered_rate": float(self.offered_rate),
+                    "service_us": {str(w): float(self.service_us[w])
+                                   for w in self.cfg.widths}},
+                "decision": {"width": int(want), "saturated": bool(sat)},
+                "prev": int(self._cur), "switched": want != self._cur})
             self.saturated = sat
             if want != self._cur:
                 self.switches.append((self._block_idx, want))
@@ -180,6 +220,59 @@ class WidthController:
     def max_backlog(self) -> int:
         return max_backlog(self._cur, self.service_us[self._cur], self.cfg)
 
+    # -- the decision journal (dintcal) ---------------------------------
+
+    def journal_shed(self, backlog: int, shed: int, *, scale: int = 1,
+                     host: int | None = None) -> None:
+        """Record one admission-shed decision: `backlog` is the queue
+        length BEFORE shedding, `shed` the lanes dropped against the
+        bound max_backlog(width, service_us[width]) * scale (`scale` is
+        the chips a mesh host feeds; 1 on the single-device plane)."""
+        w = self._cur
+        s = float(self.service_us[w])
+        self.journal.append({
+            "kind": "shed", "block": int(self._block_idx),
+            "host": None if host is None else int(host),
+            "inputs": {"width": int(w), "service_us_w": s,
+                       "backlog": int(backlog), "scale": int(scale)},
+            "decision": {
+                "bound": max_backlog(w, s, self.cfg) * int(scale),
+                "shed": int(shed)}})
+
+    def journal_hot_frac(self, cur: float, hot_hits: int,
+                         hot_cold_rows: int, rec: float) -> None:
+        """Record one hot_frac evaluation (engine rebuild boundaries):
+        the counter inputs recommend_hot_frac consumed and the outcome,
+        rebuilt or not — no-op evaluations are evidence too."""
+        self.journal.append({
+            "kind": "hot_frac", "block": int(self._block_idx),
+            "inputs": {"cur": float(cur), "hot_hits": int(hot_hits),
+                       "hot_cold_rows": int(hot_cold_rows)},
+            "decision": {"hot_frac": float(rec),
+                         "rebuilt": float(rec) != float(cur)}})
+
+    def journal_meta(self) -> dict:
+        """The journal header: everything audit replay needs beyond the
+        entries themselves (the ControllerCfg the pure policy functions
+        close over, the lanes scale, the seeding ServiceModel)."""
+        c = self.cfg
+        return {
+            "kind": "dintcal_journal", "schema": JOURNAL_SCHEMA,
+            "cfg": {"widths": [int(w) for w in c.widths],
+                    "slo_us": c.slo_us, "headroom": c.headroom,
+                    "slo_fraction": c.slo_fraction,
+                    "rate_alpha": c.rate_alpha,
+                    "service_alpha": c.service_alpha,
+                    "hysteresis_blocks": c.hysteresis_blocks},
+            "lanes_scale": self.lanes_scale,
+            "model": {"base_us": self.model.base_us,
+                      "per_lane_ns": self.model.per_lane_ns}}
+
+    def journal_doc(self) -> dict:
+        """Header + entries as one auditable document (the JSONL stream
+        is the same header line followed by one line per entry)."""
+        return {**self.journal_meta(), "entries": list(self.journal)}
+
     def snapshot(self) -> dict:
         return {
             "width": self._cur,
@@ -188,6 +281,9 @@ class WidthController:
             "service_us": dict(self.service_us),
             "switches": list(self.switches),
             "lanes_scale": self.lanes_scale,
+            "journal": list(self.journal),
+            "service_samples": {"n": self.samples_seen,
+                                "samples": [list(s) for s in self.samples]},
         }
 
 

@@ -319,6 +319,9 @@ class ServeEngine:
         if not self.adapt_hot_frac or self._hot_frac is None:
             return
         rec = self.hot_frac_recommendation(self._hot_frac)
+        self.ctl.journal_hot_frac(
+            self._hot_frac, self.counters_total.get("hot_hits", 0),
+            self.counters_total.get("hot_cold_rows", 0), rec)
         if rec == self._hot_frac:
             return
         self._hot_frac = rec
@@ -378,10 +381,13 @@ class ServeEngine:
         """Shed newest arrivals past the SLO-feasible backlog bound.
         Returns lanes shed this poll (also queued for device mirror)."""
         cap = self.ctl.max_backlog()
+        backlog0 = len(self._backlog)
         shed = 0
         while len(self._backlog) > cap:
             self._backlog.pop()               # newest first
             shed += 1
+        if shed:
+            self.ctl.journal_shed(backlog0, shed)
         self.shed_total += shed
         self._shed_pending += shed
         return shed

@@ -175,11 +175,17 @@ class MeshServeEngine(ServeEngine):
         cap = self.ctl.max_backlog() * self.n_ici
         shed = 0
         for h, bl in enumerate(self._host_backlog):
+            backlog0 = len(bl)
+            host_shed = 0
             while len(bl) > cap:
                 bl.pop()                      # newest first
                 self.shed_by_host[h] += 1
                 self._host_shed_pending[h] += 1
-                shed += 1
+                host_shed += 1
+            if host_shed:
+                self.ctl.journal_shed(backlog0, host_shed,
+                                      scale=self.n_ici, host=h)
+            shed += host_shed
         self.shed_total += shed
         self._shed_pending += shed
         return shed

@@ -543,6 +543,12 @@ def _broken_findings(pname):
         # flipped-ordering) lives with the rest of the dintplan fixtures
         import test_dintplan
         return test_dintplan.broken_plan_findings()
+    if pname == "calib_check":
+        # the canonical broken calibration fixture (hand-edited
+        # coefficient => unfit-model + stale-provenance) lives with the
+        # rest of the dintcal fixtures
+        import test_dintcal
+        return test_dintcal.broken_calib_findings()
     raise AssertionError(pname)
 
 

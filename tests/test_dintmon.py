@@ -246,6 +246,7 @@ def test_sb_dense_hot_counters_reconcile():
     assert base["hot_hits"] == base["hot_cold_rows"] == 0
 
 
+@pytest.mark.slow  # ~18s; fused parity itself is pinned in test_fused_ops
 def test_fused_dispatch_counter_reconciles():
     """Round-12 accounting: fused_dispatch counts every step whose paired
     waves ran the megakernels — equal to steps on the fused route, zero
@@ -604,6 +605,7 @@ def test_monitor_deferred_drain_deltas_bit_identical(tmp_path):
     assert sync_totals == defr_totals
 
 
+@pytest.mark.slow  # ~11s; error-path edge, not an identity pin
 def test_profiler_session_noop_and_bad_dir(tmp_path):
     from dint_tpu.monitor.trace import profiler_session
 

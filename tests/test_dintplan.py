@@ -501,10 +501,11 @@ def test_bench_plan_escape_hatch(monkeypatch):
 
 
 def test_dintgate_orchestration_smoke(tmp_path):
-    """Satellite: tools/dintgate.sh is ONE entry point for the five
-    standing gates. The smoke pins the orchestration — five gates
-    invoked in order through $PYTHON, dintplan full by default / static
-    under --quick, the four finding gates' SARIF logs merged into one
+    """Satellite: tools/dintgate.sh is ONE entry point for the six
+    standing gates. The smoke pins the orchestration — seven
+    invocations (dintcal contributes check AND the journal audit) in
+    order through $PYTHON, dintplan full by default / static under
+    --quick, the five finding gates' SARIF logs merged into one
     multi-run document, a failing gate named WITHOUT stopping the
     others — against a millisecond stub; each real gate has its own
     in-depth tests (and the full script runs in CI proper)."""
@@ -541,22 +542,26 @@ def test_dintgate_orchestration_smoke(tmp_path):
                        capture_output=True, text=True, env=env,
                        timeout=120)
     assert r.returncode == 0, r.stdout + r.stderr
-    assert "all 5 gates ok" in r.stdout
+    assert "all 6 gates ok" in r.stdout
 
     lines = calls.read_text().splitlines()
     assert [ln.split()[0].rsplit("/", 1)[-1] for ln in lines] == \
         ["dintlint.py", "dintcost.py", "dintdur.py", "dintplan.py",
-         "dintmon.py"]
+         "dintmon.py", "dintcal.py", "dintcal.py"]
     assert "--all" in lines[0] and "check --all" in lines[1]
     assert "--static" not in lines[3]        # default: the FULL gate
     assert lines[4].endswith("tests/fixtures/dintmon_counters.json")
     assert os.path.exists(os.path.join(
         REPO, "tests", "fixtures", "dintmon_counters.json"))
+    assert "check" in lines[5] and "--sarif" in lines[5]
+    assert lines[6].endswith("tests/fixtures/dintcal_journal.jsonl")
+    assert os.path.exists(os.path.join(
+        REPO, "tests", "fixtures", "dintcal_journal.jsonl"))
 
     doc = json.loads(merged.read_text())
     assert doc["version"] == "2.1.0"
     assert sorted(r_["tool"]["driver"]["name"] for r_ in doc["runs"]) \
-        == ["dintcost", "dintdur", "dintlint", "dintplan"]
+        == ["dintcal", "dintcost", "dintdur", "dintlint", "dintplan"]
 
     # --quick keeps the planner gate static
     calls.write_text("")
@@ -571,7 +576,7 @@ def test_dintgate_orchestration_smoke(tmp_path):
                        env=dict(env, FAIL_DUR="1"), timeout=120)
     assert r.returncode == 1
     assert "dintgate: FAIL" in r.stdout and "dintdur" in r.stdout
-    assert len(calls.read_text().splitlines()) == 5   # no fail-fast
+    assert len(calls.read_text().splitlines()) == 7   # no fail-fast
 
     # unknown flags are a usage error; --help documents the contract
     assert subprocess.run(["bash", script, "--frobnicate"],

@@ -303,5 +303,24 @@ def test_exp_artifacts_carry_schema_breakdown_and_histogram(tmp_path):
         assert "breakdown" in b and b["breakdown"] is None   # no trace dir
         h = b["lat_hist"]
         assert h["n"] > 0 and h["buckets"]
-        # the histogram's own percentile read sits near the reservoir's
-        assert h["p50_us"] == pytest.approx(b["p50_us"], rel=0.10)
+        # Two views of one sample stream must agree where agreement is
+        # guaranteed: the mean exactly (both track a sum), and the
+        # reservoir's interpolated p50 inside the bucket span bracketing
+        # the middle order statistics. A rel=0.10 p50 compare only holds
+        # at scale on unimodal samples (test_stats.py) — a short measured
+        # window's median can straddle a bimodal steady-state/contended
+        # gap, where interpolation and the ceil-rank read legitimately
+        # diverge.
+        from dint_tpu import stats as dstats
+        hist = dstats.LatencyHistogram.from_dict(h)
+        assert h["avg_us"] == pytest.approx(b["avg_us"], rel=1e-4,
+                                            abs=0.02)
+        assert h["p50_us"] == round(hist.quantile(0.5), 2)
+        cum = np.cumsum(hist.counts)
+        n = hist.n
+        lo_rank, hi_rank = (n + 1) // 2, n // 2 + 1
+        i_lo = int(np.searchsorted(cum, lo_rank))
+        i_hi = int(np.searchsorted(cum, hi_rank))
+        lo_edge = 2.0 ** (h["lo_exp"] + i_lo / h["per_octave"])
+        hi_edge = 2.0 ** (h["lo_exp"] + (i_hi + 1) / h["per_octave"])
+        assert lo_edge * 0.999 <= b["p50_us"] <= hi_edge * 1.001

@@ -619,8 +619,14 @@ def test_plan_resolved_run_bit_identical_to_hand_config():
             eng.close()
         return eng.snapshot()
 
+    # the plan's serve priors carry the resolver's ServiceModel (pinned
+    # CALIB.json coefficients when present) — the hand config must pass
+    # the SAME model or the two runs legitimately diverge
+    from dint_tpu.monitor.calib import resolve_service_model
+    model, _ = resolve_service_model()
+
     a = snap()                                       # plan-resolved
-    b = snap(plan=None,                              # ... by hand
+    b = snap(plan=None, model=model,                 # ... by hand
              runner_kw={"hot_frac": wl.SB_HOT_FRAC})
     assert a["plan"] is not None and b["plan"] is None
     a.pop("plan"), b.pop("plan")

@@ -7,6 +7,7 @@ import pytest
 import exp
 
 
+@pytest.mark.slow  # ~48s of compiles on the 1-core tier-1 box
 def test_quick_tatp_sweep(tmp_path):
     out = str(tmp_path / "res")
     results = exp.run_all(out, window_s=0.4, quick=True, only="tatp")

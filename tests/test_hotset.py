@@ -254,6 +254,7 @@ def test_smallbank_hashed_locks_skip_stamp_mirror(monkeypatch):
 # ----------------------------------------------- end-to-end: sharded
 
 
+@pytest.mark.slow  # ~11s; the round-10 rule — dense + store hot pins stay tier-1
 def test_dense_sharded_sb_hotset_bit_identical():
     """Two configs in tier-1 (baseline vs hot tier on the VMEM kernels —
     the XLA-partition route is pinned on single-chip above); one shard_map

@@ -29,6 +29,7 @@ def _run(n_sub_global, w, blocks, seed=0, h=H, c=C):
     return state, total
 
 
+@pytest.mark.slow  # ~18s; the 1-D-totals equivalence pin stays tier-1
 def test_accounting_closes_over_2d_mesh():
     state, total = _run(n_sub_global=D * 256, w=64, blocks=3)
     attempted = int(total[td.STAT_ATTEMPTED])

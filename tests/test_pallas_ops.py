@@ -234,7 +234,8 @@ def test_tatp_dense_pallas_contention_bit_identical():
     assert _trees_equal(db_x, db_p)
 
 
-def test_dense_sharded_pallas_bit_identical():
+@pytest.mark.slow  # ~46s; the round-10 budget rule — kernel mechanics and
+def test_dense_sharded_pallas_bit_identical():  # both dense parities stay tier-1
     """The tentpole's multi-chip integration: the 8-virtual-device sharded
     TATP runner (shard_map bodies run the kernels on their LOCAL shard
     arrays) is bit-identical XLA-vs-pallas — stats, tables, backups, logs."""
@@ -286,6 +287,7 @@ def test_dense_sharded_sb_pallas_bit_identical():
     assert _trees_equal(s_x, s_p)
 
 
+@pytest.mark.slow  # ~19s; both dense pallas bit-identity pins stay tier-1
 def test_tatp_dense_pallas_matches_generic_engine_oracle(monkeypatch):
     """ISSUE 1 acceptance: the EXISTING TATP dense parity test — dense
     engine vs the generic sort-based pipelined engine, the differential

@@ -286,7 +286,8 @@ def test_fasst_wire_occ_roundtrip(rng):
             assert r["n"] == 1 and r["type"][0] == 5
 
 
-def test_tatp_full_transactions_over_wire():
+@pytest.mark.slow  # ~58s: heaviest wire e2e; the per-op wire roundtrips
+def test_tatp_full_transactions_over_wire():  # above stay tier-1
     """FULL TATP transactions over the wire against 3 UDP shard servers —
     the reference's client/server topology (3 server processes + a
     coordinator fanning per-shard batches, client_ebpf_shard.cc:636-677)

@@ -1,6 +1,7 @@
 """Device-fused SmallBank pipeline: invariants + contention response."""
 import jax
 import numpy as np
+import pytest
 
 from dint_tpu.engines import smallbank_pipeline as sp
 
@@ -48,6 +49,7 @@ def test_invariants_small():
     assert heads[0] == heads[1] == heads[2] > 0
 
 
+@pytest.mark.slow  # ~32s; invariants + host-coordinator oracle stay tier-1
 def test_abort_rate_responds_to_contention():
     # tiny hot set + wide cohort -> heavy lock contention; large keyspace ->
     # almost none. The no-wait 2PL reject semantics must show the difference.

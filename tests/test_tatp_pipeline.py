@@ -69,6 +69,7 @@ def test_no_locks_leak(stacked):
     assert not np.asarray(st.cf_lock.locked).any()
 
 
+@pytest.mark.slow  # ~23s; accounting + lock-leak checks stay tier-1
 def test_abort_rate_matches_host_coordinator():
     """Same workload params -> fused and host-wave abort rates agree within
     noise (both serialize conflicts by per-cohort lock certification)."""

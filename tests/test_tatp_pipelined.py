@@ -1,6 +1,7 @@
 """Cross-cohort pipelined TATP: real concurrency, live ab_validate."""
 import jax
 import numpy as np
+import pytest
 
 from dint_tpu.clients import tatp_client as tc
 from dint_tpu.engines import tatp_pipeline as tp
@@ -57,6 +58,7 @@ def test_contention_fires_validate_aborts():
     assert outcomes == attempted
 
 
+@pytest.mark.slow  # ~16s; contention + drain invariants stay tier-1
 def test_low_contention_mostly_commits():
     stacked, total = _run(n_sub=20_000, w=64, blocks=3)
     attempted = int(total[tp.STAT_ATTEMPTED])

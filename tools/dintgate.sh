@@ -1,22 +1,28 @@
 #!/usr/bin/env bash
-# dintgate: ONE entry point for all five standing static gates.
+# dintgate: ONE entry point for all six standing static gates.
 #
 #   tools/dintgate.sh [--quick] [--sarif PATH]
 #
 # Gates, in dependency-free order:
 #   1. dintlint --all          every analysis pass over every target
-#                              (plan_check rides along in STATIC form)
+#                              (plan_check + calib_check ride along in
+#                              STATIC form)
 #   2. dintcost check --all    the priced budget/parity/overlap gate
 #   3. dintdur  check --all    the durability/replication gate
 #   4. dintplan check          the FULL planner gate (re-derives every
 #                              frontier price; --quick keeps it static)
 #   5. dintmon  check          the counter-identity gate on the pinned
 #                              fixture artifact (no trace run needed)
+#   6. dintcal  check+audit    the calibration gate: pinned CALIB.json
+#                              reconciles with its evidence fixture, and
+#                              the checked-in decision journal replays
+#                              bit-for-bit through the pure policy
 #
-# --sarif PATH merges the four finding gates' SARIF logs into one
+# --sarif PATH merges the five finding gates' SARIF logs into one
 # multi-run SARIF 2.1.0 document (one runs[] entry per gate driver) —
-# upload-ready for code-scanning UIs. dintmon is a numeric identity
-# check, not a findings pass, so it reports via exit code only.
+# upload-ready for code-scanning UIs. dintmon and dintcal audit are
+# numeric identity checks, not findings passes, so they report via exit
+# code only.
 #
 # Exit 0 iff EVERY gate passed; each failing gate is named. All gates
 # always run (no fail-fast) so one invocation reports the full damage.
@@ -61,6 +67,8 @@ run_gate dintcost "$PY" tools/dintcost.py check --all --sarif "$TMP/cost.sarif"
 run_gate dintdur  "$PY" tools/dintdur.py check --all --sarif "$TMP/dur.sarif"
 run_gate dintplan "$PY" tools/dintplan.py check $PLAN_ARGS --sarif "$TMP/plan.sarif"
 run_gate dintmon  "$PY" tools/dintmon.py check tests/fixtures/dintmon_counters.json
+run_gate dintcal  "$PY" tools/dintcal.py check --sarif "$TMP/cal.sarif"
+run_gate dintcal-audit "$PY" tools/dintcal.py audit tests/fixtures/dintcal_journal.jsonl
 
 if [ -n "$SARIF" ]; then
     "$PY" - "$SARIF" "$TMP"/*.sarif <<'MERGE'
@@ -84,7 +92,7 @@ MERGE
 fi
 
 if [ -z "$FAIL" ]; then
-    echo "dintgate: all 5 gates ok"
+    echo "dintgate: all 6 gates ok"
     exit 0
 fi
 echo "dintgate: FAIL —$FAIL"

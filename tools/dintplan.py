@@ -14,6 +14,7 @@ without DINT_PLAN_OVERRIDE=1 (passes/plan_check.py).
 
 Usage:
     python tools/dintplan.py plan [-o PLAN.json] [--json]
+        [--calib CALIB.json]                    # re-pin from evidence
     python tools/dintplan.py check                       # the CI gate
         [--static] [--plan PATH]
         [--allowlist tools/dintlint_allow.json] [--json]
@@ -63,6 +64,11 @@ JSON_SCHEMA = 1
 
 
 def cmd_plan(args, ap) -> int:
+    if args.calib:
+        # re-pin from evidence: serve_priors resolves its ServiceModel
+        # through monitor/calib.resolve_service_model, which honours
+        # this override (the dintcal `propose` -> `plan --calib` loop)
+        os.environ["DINT_CALIB_PATH"] = args.calib
     plan = P.build_plan()
     out = args.out or P.plan_path()
     path = P.save_plan(plan, out)
@@ -182,6 +188,10 @@ def main(argv=None) -> int:
     p.add_argument("-o", "--out", default=None,
                    help="output path (default: the pinned "
                         "<repo>/PLAN.json, or $DINT_PLAN_PATH)")
+    p.add_argument("--calib", metavar="CALIB.json", default=None,
+                   help="price serve priors with this dintcal "
+                        "calibration (sets DINT_CALIB_PATH for the "
+                        "build) — the evidence-driven re-pin route")
     p.add_argument("--json", action="store_true")
     p.set_defaults(fn=cmd_plan)
 

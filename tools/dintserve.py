@@ -112,6 +112,11 @@ def cmd_run(args) -> int:
     eng.run(_schedule(args))
     eng.close()
     rep = eng.snapshot()
+    if args.journal:
+        # stream the decision journal as JSONL (header + one entry per
+        # line) — `dintcal audit` replays it bit-for-bit
+        from dint_tpu.monitor import calib as CAL
+        CAL.dump_journal_jsonl(eng.ctl.journal_doc(), args.journal)
     if args.json:
         print(json.dumps(rep))
         return 0 if rep["slo_met"] or args.no_gate else 1
@@ -156,15 +161,24 @@ def cmd_run(args) -> int:
 
 
 def cmd_simulate(args) -> int:
+    from dint_tpu.monitor.calib import resolve_service_model
     from dint_tpu.serve import ControllerCfg, ServiceModel, simulate_widths
     cfg = ControllerCfg(
         widths=_widths(args.widths or "256,1024,4096,8192"),
         slo_us=args.slo_us if args.slo_us is not None else 5_000.0)
-    model = ServiceModel(
-        base_us=args.model_base_us if args.model_base_us is not None
-        else 150.0,
-        per_lane_ns=args.model_per_lane_ns
-        if args.model_per_lane_ns is not None else 40.0)
+    # explicit flags win; otherwise THE resolver (pinned CALIB.json
+    # coefficients when present, ServiceModel defaults otherwise) — and
+    # the report says which, so simulated capacity claims are
+    # attributable to their coefficient source
+    if args.model_base_us is not None or args.model_per_lane_ns is not None:
+        model = ServiceModel(
+            base_us=args.model_base_us if args.model_base_us is not None
+            else 150.0,
+            per_lane_ns=args.model_per_lane_ns
+            if args.model_per_lane_ns is not None else 40.0)
+        model_meta = {"source": "flags", "path": None, "hash": None}
+    else:
+        model, model_meta = resolve_service_model()
     shape = _mesh_shape(args.mesh) if args.mesh else None
     widths = simulate_widths(_schedule(args), cfg, model,
                              cohorts_per_block=args.cpb,
@@ -173,12 +187,21 @@ def cmd_simulate(args) -> int:
     out = {"widths": sorted(set(widths)), "blocks": len(widths),
            "trajectory": widths if args.json else None,
            "final_width": widths[-1] if widths else None,
-           "mesh": list(shape) if shape else None}
+           "mesh": list(shape) if shape else None,
+           "model": {"base_us": model.base_us,
+                     "per_lane_ns": model.per_lane_ns, **model_meta}}
     if args.json:
         print(json.dumps(out))
         return 0
+    src = model_meta["source"].upper()
+    if src == "DEFAULTS":
+        src = "DEFAULTS (no CALIB.json)"
+    elif model_meta["hash"]:
+        src += f" {model_meta['path']} ({model_meta['hash']})"
     print(f"simulate: {len(widths)} blocks; final width "
           f"{out['final_width']}")
+    print(f"  model: base_us={model.base_us} "
+          f"per_lane_ns={model.per_lane_ns} source={src}")
     # compressed trajectory: width x run-length
     runs, prev = [], None
     for w in widths:
@@ -275,6 +298,10 @@ def main() -> int:
             p.add_argument("--no-monitor", action="store_true")
             p.add_argument("--no-gate", action="store_true",
                            help="exit 0 even when the SLO is missed")
+            p.add_argument("--journal", metavar="PATH", default=None,
+                           help="stream the controller decision journal "
+                                "as JSONL (replayable bit-for-bit with "
+                                "`dintcal audit`)")
 
     common(sub.add_parser("run", help="serve a schedule"), engine=True)
     common(sub.add_parser("simulate",

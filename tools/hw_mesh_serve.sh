@@ -86,6 +86,7 @@ echo "=== stage 4: saturating mesh point (global controller + shed) ==="
 DINT_BENCH_MESH="$MESH" timeout 1200 python tools/dintserve.py run \
     --mesh "$MESH" --size 1000000 --rate 50000000 --window 1 \
     --slo-us 5000 --widths 256,1024,4096 --overlap --no-gate --json \
+    --journal serve_mesh_journal.jsonl \
     > serve_mesh_saturated.json || true
 tail -1 serve_mesh_saturated.json
 
@@ -98,5 +99,17 @@ DINT_BENCH_MESH="$MESH" DINT_MONITOR=1 DINT_SERVE_OVERLAP=1 \
     timeout 1200 python exp.py --quick --only serve_mesh \
     --out serve_mesh_mon > serve_mesh_mon.log 2>&1 || true
 python tools/dintmon.py summarize mon_r18_mesh.jsonl | tail -8 || true
+
+echo "=== stage 6: archive CALIB evidence + recalibration proposal ==="
+# mesh-measured (width, service) samples + the per-host shed journal
+# feed the dintcal loop: re-pin with `dintplan plan --calib`, never a
+# DINT_PLAN_OVERRIDE=1 hand edit
+JAX_PLATFORMS=cpu python tools/dintcal.py gather serve_mesh_*.json \
+    -o calib_evidence_mesh.json || true
+JAX_PLATFORMS=cpu python tools/dintcal.py propose \
+    --evidence calib_evidence_mesh.json -o CALIB.mesh.proposed.json \
+    || true
+JAX_PLATFORMS=cpu python tools/dintcal.py audit serve_mesh_journal.jsonl \
+    || true
 
 echo "=== done ==="

@@ -70,4 +70,10 @@ print('$leg', d.get('extra', d).get('width'), 'goodput',
     done
 done
 echo "apply the PERF.md round-14 rule to the two goodput lines above"
+echo "=== archive CALIB evidence (dintcal) ==="
+# every hardware round archives its measured evidence in dintcal's
+# normalized form so a recalibration is one `dintcal fit` away
+JAX_PLATFORMS=cpu python tools/dintcal.py gather exp_results/*.json \
+    -o calib_evidence_hw_multihost.json || true
+
 echo "=== done ==="

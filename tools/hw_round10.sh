@@ -73,4 +73,10 @@ timeout 2400 python exp.py --only smallbank_skew --window 5 \
 DINT_USE_HOTSET=1 timeout 2400 python exp.py --only smallbank_skew \
     --window 5 --out exp_results/skew_on > skew_on.log 2>&1 || true
 
+echo "=== archive CALIB evidence (dintcal) ==="
+# every hardware round archives its measured evidence in dintcal's
+# normalized form so a recalibration is one `dintcal fit` away
+JAX_PLATFORMS=cpu python tools/dintcal.py gather dintscope_r10_*.json bench_hot_*.json \
+    -o calib_evidence_hw_round10.json || true
+
 echo "=== done ==="

@@ -88,4 +88,10 @@ DINT_USE_FUSED=1 DINT_MONITOR=1 DINT_MONITOR_JSONL=mon_r12_fused.jsonl \
     2> bench_fused_mon_stderr.log || true
 python tools/dintmon.py summarize mon_r12_fused.jsonl | tail -5 || true
 
+echo "=== archive CALIB evidence (dintcal) ==="
+# every hardware round archives its measured evidence in dintcal's
+# normalized form so a recalibration is one `dintcal fit` away
+JAX_PLATFORMS=cpu python tools/dintcal.py gather dintscope_r12_*.json bench_fused_*.json \
+    -o calib_evidence_hw_round12.json || true
+
 echo "=== done ==="

@@ -54,4 +54,10 @@ fi
 JAX_PLATFORMS=cpu python tools/dintcost.py report --all --json \
     > dintcost_r6.json 2>> dintscope_r6.log || true
 
+echo "=== archive CALIB evidence (dintcal) ==="
+# every hardware round archives its measured evidence in dintcal's
+# normalized form so a recalibration is one `dintcal fit` away
+JAX_PLATFORMS=cpu python tools/dintcal.py gather dintscope_r6_*.json bench_xla.json bench_pallas.json \
+    -o calib_evidence_hw_round6.json || true
+
 echo "=== done ==="

@@ -62,6 +62,7 @@ echo "=== stage 4: saturating point (width controller up + shed) ==="
 timeout 1200 python tools/dintserve.py run --engine tatp_dense \
     --size 7000000 --rate 50000000 --window 1 --slo-us 5000 \
     --widths 256,1024,4096,8192 --no-gate --json \
+    --journal serve_saturated_journal.jsonl \
     > serve_saturated.json || true
 tail -1 serve_saturated.json
 
@@ -72,5 +73,16 @@ echo "=== stage 5: static model beside the measurements ==="
 JAX_PLATFORMS=cpu python tools/dintcost.py report --all --json \
     > dintcost_r17.json 2> /dev/null || true
 JAX_PLATFORMS=cpu python tools/dintserve.py describe || true
+
+echo "=== stage 6: archive CALIB evidence + recalibration proposal ==="
+# dintcal closes the loop: the measured (width, service) samples and
+# journals feed a recalibration the operator re-pins with
+# `dintplan plan --calib` — never a DINT_PLAN_OVERRIDE=1 hand edit
+JAX_PLATFORMS=cpu python tools/dintcal.py gather serve_*.json \
+    -o calib_evidence_serve.json || true
+JAX_PLATFORMS=cpu python tools/dintcal.py propose \
+    --evidence calib_evidence_serve.json -o CALIB.proposed.json || true
+JAX_PLATFORMS=cpu python tools/dintcal.py audit \
+    serve_saturated_journal.jsonl || true
 
 echo "=== done ==="
