@@ -29,10 +29,15 @@ walking machinery and ANALYSIS.md for the invariant catalogue):
                      and the plan's serve rows were priced with the
                      model the resolver picks now (monitor/calib.py —
                      the dintcal gate)
+  mut_check          the pinned MUTCOV.json (machine-generated engine
+                     mutants vs the pass matrix) stays provenance-true,
+                     clears the kill-rate floor, triages every
+                     survivor, and attributes kills to every gate
+                     family (analysis/mutate.py — the dintmut gate)
 
 Adding a pass: write `passes/<name>.py`, decorate the entry point with
 `@core.register_pass("<name>")`, import it here.
 """
 from . import (aliasing, calib_check, cost_budget,  # noqa: F401
-               durability, plan_check, protocol, purity, scatter_race,
-               shard_consistency, u64_overflow)
+               durability, mut_check, plan_check, protocol, purity,
+               scatter_race, shard_consistency, u64_overflow)

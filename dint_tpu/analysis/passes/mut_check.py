@@ -1,0 +1,293 @@
+"""dintmut gate: the pinned MUTCOV.json must stay true and sufficient.
+
+analysis/mutate.py corrupts the traced engines with registered operators
+and records which pass killed each mutant; this pass fails closed when
+that pinned evidence goes missing, stale, or stops clearing the policy
+bar (ANALYSIS.md "Mutation coverage (dintmut)"):
+
+  missing-mutcov      no MUTCOV.json at the resolved path: the gate
+                      matrix's kill claims are unevidenced again
+  malformed-mutcov    unparseable / wrong schema / missing sections
+  stale-provenance    the recorded registry/matrix/cells hashes no
+                      longer match this tree: the operator registry,
+                      the MUT_TARGETS matrix, or the cell records
+                      changed after the artifact was pinned
+  summary-drift       the recorded summary (or the pinned quick sample)
+                      is not what the recorded cells recompute to —
+                      rows were edited without re-pinning
+  kill-rate-floor     kill rate over the full matrix fell below
+                      mutate.KILL_RATE_FLOOR
+  survivor            one ERROR per surviving mutant: a survivor is a
+                      corruption no gate can see — either a new pass to
+                      write or a documented non-goal, NEVER silence.
+                      Triage = an allowlist entry pinned to the cell id
+                      ({"pass": "mut_check", "code": "survivor",
+                        "site": "<cell id>", "reason": ...}); the
+                      written reason is the documentation
+  operator-dormant    a registered operator produced ZERO cells across
+                      the whole matrix: its finder found no sites
+                      anywhere, so the kill rate silently stopped
+                      covering that corruption class
+  attribution-gap     the kill matrix no longer attributes at least one
+                      kill to every required gate family (protocol,
+                      durability, cost_budget, and a core dintlint
+                      structural pass) — the acceptance bar, machine-
+                      checked
+  ring-triage-drift   a ring-family cell (ring-shrink, or the drop-eqn
+                      log-append drop) no longer records the ONE
+                      standing `durability/no-ring-truncation`
+                      suppression, or that entry vanished from the
+                      shared allowlist while the cells still cite it:
+                      the ROADMAP log-truncation item is tracked by
+                      this cross-reference, not by comments
+
+The whole-artifact checks are global, so they anchor to ONE registered
+target (mutate.DEFAULT_ANCHOR, override DINT_MUT_ANCHOR) and return []
+everywhere else — `dintlint --all` and `dintmut check` both land the
+findings exactly once. Embedded in dintlint the pass is purely STATIC:
+provenance hashes + recorded cells, no tracing, no mutant re-runs (the
+re-execution tiers live in tools/dintmut.py: `check` re-runs the full
+matrix bit-for-bit, `check --quick` the pinned sample).
+"""
+from __future__ import annotations
+
+import json
+import os
+
+from .. import mutate as M
+from ..core import Finding, SEV_ERROR, TargetTrace, register_pass
+
+DEFAULT_ANCHOR = "tatp_dense/block"
+ENV_MUT_ANCHOR = "DINT_MUT_ANCHOR"
+
+# the ring-family operators whose cells must cite the standing
+# durability/no-ring-truncation suppression (hygiene cross-reference)
+_RING_OPS = ("ring-shrink",)
+_RING_ENTRY = "durability/no-ring-truncation"
+
+# the acceptance bar: at least one kill attributed to each family; the
+# "core" family is any structural dintlint pass outside the three
+# protocol/durability/cost planes
+_CORE_PASSES = frozenset({"scatter_race", "aliasing", "purity",
+                          "u64_overflow", "shard_consistency"})
+_REQUIRED_FAMILIES = (("protocol", ("protocol",)),
+                      ("durability", ("durability",)),
+                      ("cost_budget", ("cost_budget",)),
+                      ("core dintlint", tuple(sorted(_CORE_PASSES))))
+
+_SUGGEST_REGEN = ("regenerate with `python tools/dintmut.py run` and "
+                  "review the MUTCOV.json diff like any gate change")
+
+_CELL_KEYS = ("id", "target", "operator", "site", "note", "verdict",
+              "killer", "new_errors", "suppressed")
+
+
+def _err(code: str, target: str, message: str, site: str = "",
+         suggestion: str = _SUGGEST_REGEN) -> Finding:
+    return Finding("mut_check", code, SEV_ERROR, target, message,
+                   site=site, suggestion=suggestion)
+
+
+def load_mutcov_findings(target: str, path=None
+                         ) -> tuple[dict | None, list[Finding]]:
+    """(doc, findings) for the pinned MUTCOV file: missing-mutcov /
+    malformed-mutcov on failure, else the parsed document."""
+    path = path or M.mutcov_path()
+    try:
+        return M.load_mutcov(path), []
+    except FileNotFoundError:
+        return None, [_err(
+            "missing-mutcov", target,
+            f"no mutation-coverage artifact at {path}: the gate matrix's "
+            "kill claims are backed by nothing machine-checked",
+            site=str(path),
+            suggestion="generate it with `python tools/dintmut.py run` "
+                       "(or point DINT_MUTCOV at the pinned copy)")]
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        return None, [_err(
+            "malformed-mutcov", target,
+            f"unreadable MUTCOV at {path}: {e}", site=str(path))]
+
+
+def _structure_findings(doc: dict, target: str) -> list[Finding]:
+    out: list[Finding] = []
+    for key in ("provenance", "cells", "summary", "quick", "operators",
+                "targets"):
+        if key not in doc:
+            out.append(_err("malformed-mutcov", target,
+                            f"MUTCOV is missing its {key!r} section",
+                            site=key))
+    for c in doc.get("cells", []) if isinstance(doc.get("cells"), list) \
+            else []:
+        missing = [k for k in _CELL_KEYS if k not in c]
+        if missing:
+            out.append(_err(
+                "malformed-mutcov", target,
+                f"cell {c.get('id', '?')!r} is missing {missing}",
+                site=str(c.get("id", "?"))))
+    return out
+
+
+def _provenance_findings(doc: dict, target: str) -> list[Finding]:
+    out: list[Finding] = []
+    prov = doc.get("provenance", {})
+    for key, fresh, what in (
+            ("registry", M.registry_hash(),
+             "operator registry / pass matrix / policy knobs"),
+            ("matrix", M.matrix_hash(),
+             "MUT_TARGETS matrix (targets, protocols, operator sets)"),
+            ("cells", M._digest(doc.get("cells", [])),
+             "recorded cell rows")):
+        got = prov.get(key)
+        if got != fresh:
+            out.append(_err(
+                "stale-provenance", target,
+                f"recorded {key} hash {got!r} != current {fresh!r}: the "
+                f"{what} changed after MUTCOV was pinned", site=key))
+    return out
+
+
+def _summary_findings(doc: dict, target: str) -> list[Finding]:
+    out: list[Finding] = []
+    cells = doc.get("cells", [])
+    fresh = M._summary(cells)
+    if doc.get("summary") != fresh:
+        diffs = [f"{k}: {doc.get('summary', {}).get(k)!r} -> {fresh[k]!r}"
+                 for k in fresh if doc.get("summary", {}).get(k)
+                 != fresh[k]]
+        out.append(_err(
+            "summary-drift", target,
+            "recorded summary is not what the recorded cells recompute "
+            f"to ({'; '.join(diffs)})", site="summary"))
+    quick = doc.get("quick", {})
+    want = M.quick_sample(cells, quick.get("seed", M.QUICK_SEED))
+    if quick.get("cells") != want:
+        out.append(_err(
+            "summary-drift", target,
+            f"pinned quick sample {quick.get('cells')!r} is not what "
+            f"seed {quick.get('seed')!r} deterministically draws from "
+            f"the recorded cells ({want!r})", site="quick"))
+    return out
+
+
+def _policy_findings(doc: dict, target: str) -> list[Finding]:
+    out: list[Finding] = []
+    cells = doc.get("cells", [])
+    summary = M._summary(cells)
+    floor = doc.get("kill_rate_floor", M.KILL_RATE_FLOOR)
+    if summary["kill_rate"] < floor:
+        out.append(_err(
+            "kill-rate-floor", target,
+            f"kill rate {summary['kill_rate']:.2%} over "
+            f"{summary['n_cells']} mutants fell below the "
+            f"{floor:.0%} floor: the gates stopped catching what they "
+            "claim", site="kill_rate",
+            suggestion="strengthen the losing pass (see the surviving "
+                       "cells' operators) — do not lower the floor"))
+    for c in cells:
+        if c.get("verdict") == "survived":
+            out.append(_err(
+                "survivor", target,
+                f"mutant {c.get('id')} ({c.get('operator')}: "
+                f"{c.get('note')}) survived every gate — a corruption "
+                "the static plane cannot see", site=str(c.get("id")),
+                suggestion="either write the pass that kills it, or "
+                           "triage it as a documented non-goal with an "
+                           "allowlist entry pinned to this cell id "
+                           "(reason required) — never silence"))
+        elif c.get("verdict") not in ("killed",):
+            out.append(_err(
+                "malformed-mutcov", target,
+                f"cell {c.get('id')!r} has unknown verdict "
+                f"{c.get('verdict')!r}", site=str(c.get("id"))))
+    # an operator assigned in the matrix that produced zero cells is a
+    # silently shrunk denominator, not a clean sheet
+    assigned = {op for t in doc.get("targets", {}).values()
+                for op in t.get("operators", [])}
+    live = {c.get("operator") for c in cells}
+    for op in sorted(assigned - live):
+        out.append(_err(
+            "operator-dormant", target,
+            f"operator {op!r} is assigned in the target matrix but "
+            "produced no cells: its finder located no sites anywhere, "
+            "so that corruption class is no longer exercised", site=op))
+    killers = set(summary["killer_passes"])
+    for fam, passes in _REQUIRED_FAMILIES:
+        if not killers & set(passes):
+            out.append(_err(
+                "attribution-gap", target,
+                f"no kill is attributed to the {fam} family "
+                f"({'/'.join(passes)}): the matrix no longer proves "
+                "that plane bites", site=fam))
+    return out
+
+
+def _ring_findings(doc: dict, target: str, allow_path=None
+                   ) -> list[Finding]:
+    """The hygiene cross-reference: ring-family cells must record the
+    ONE standing durability/no-ring-truncation suppression, and that
+    entry must still exist while cells cite it."""
+    from ..cli import DEFAULT_ALLOWLIST
+    out: list[Finding] = []
+    ring_cells = [c for c in doc.get("cells", [])
+                  if c.get("operator") in _RING_OPS]
+    for c in ring_cells:
+        if _RING_ENTRY not in (c.get("suppressed") or []):
+            out.append(_err(
+                "ring-triage-drift", target,
+                f"ring cell {c.get('id')} no longer records the "
+                f"standing {_RING_ENTRY} suppression: either log "
+                "truncation landed (retire the allowlist entry and "
+                "re-pin) or the truncation facts broke",
+                site=str(c.get("id"))))
+    if not ring_cells:
+        return out
+    path = allow_path or DEFAULT_ALLOWLIST
+    try:
+        with open(path) as fh:
+            entries = json.load(fh)
+    except (OSError, ValueError):
+        return out                  # allowlist health is dintlint's job
+    standing = any(e.get("pass") == "durability"
+                   and e.get("code") == "no-ring-truncation"
+                   for e in entries if isinstance(e, dict))
+    if not standing:
+        out.append(_err(
+            "ring-triage-drift", target,
+            f"MUTCOV ring cells still cite {_RING_ENTRY} but the "
+            f"standing entry is gone from {os.path.basename(path)}: "
+            "re-run the matrix so the cells reflect the retired "
+            "suppression", site=_RING_ENTRY))
+    return out
+
+
+def check_mutcov(doc: dict, target: str, *, allow_path=None
+                 ) -> list[Finding]:
+    """Every mut_check finding for a parsed MUTCOV document (the fixture
+    tests feed mutated documents straight in here)."""
+    out = _structure_findings(doc, target)
+    if out:
+        return out
+    out += _provenance_findings(doc, target)
+    out += _summary_findings(doc, target)
+    out += _policy_findings(doc, target)
+    out += _ring_findings(doc, target, allow_path)
+    return out
+
+
+def _anchor() -> str:
+    return os.environ.get(ENV_MUT_ANCHOR, DEFAULT_ANCHOR)
+
+
+@register_pass("mut_check")
+def mut_check(trace: TargetTrace) -> list[Finding]:
+    """Verifies the pinned MUTCOV.json against the operator registry,
+    the target matrix and the kill-rate/triage policy (whole-artifact
+    checks, anchored to one target; static — mutant re-execution is
+    `dintmut check`'s job)."""
+    if trace.name != _anchor():
+        return []
+    doc, findings = load_mutcov_findings(trace.name)
+    if doc is None:
+        return findings
+    return findings + check_mutcov(doc, trace.name)

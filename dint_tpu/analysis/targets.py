@@ -1204,6 +1204,40 @@ TARGET_COST.update({
 })
 
 
+# ------------------------------------------------- mutation-target matrix
+
+# The dintmut matrix (analysis/mutate.py): which targets get corrupted,
+# and with which operators. One representative per engine family — the
+# operator set per target reflects what the engine actually contains
+# (e.g. axis-swap needs live ppermutes, ring-shrink needs the durable
+# unfused log ring, drop-donation needs a top-level donated pjit) so
+# "no sites found" stays a loud mut_check error (operator-dormant), not
+# an expected blank. Kept here (not in mutate.py) because mutability is
+# a property of the TARGET: adding an engine family means deciding which
+# corruption classes apply to it, exactly like TARGET_PROTOCOL.
+MUT_TARGETS: dict[str, tuple[str, ...]] = {
+    # single-chip certified+occ TATP: the lock/validate/install loop,
+    # the donated pjit, and the durable log ring are all in one trace
+    "tatp_dense/block": ("drop-eqn", "weaken-scatter", "mask-swap",
+                         "widen-gather", "drop-donation", "ring-shrink"),
+    # single-chip certified SmallBank (no occ validate): same fabric,
+    # different protocol flags — proves kills do not depend on occ
+    "smallbank_dense/block": ("drop-eqn", "weaken-scatter", "mask-swap",
+                              "widen-gather", "ring-shrink"),
+    # 4-way replicated+occ shard_map TATP: replication hops exist, so
+    # the ppermute operators come into play
+    "dense_sharded/block": ("drop-eqn", "mask-swap", "axis-swap",
+                            "ring-shrink"),
+    # replicated SmallBank shards: the weaken/widen operators against a
+    # sharded byte ledger
+    "dense_sharded_sb/block": ("drop-eqn", "weaken-scatter", "axis-swap",
+                               "widen-gather"),
+    # 2-D (dcn x ici) mesh: the only target where dcn->ici rerouting is
+    # expressible — the axis-swap dcn variant lives here
+    "multihost_sb/block": ("drop-eqn", "axis-swap", "ring-shrink"),
+}
+
+
 # ----------------------------------------------------------------- API
 
 # trace-once cache shared by every pass in every analysis.run() of the

@@ -549,6 +549,12 @@ def _broken_findings(pname):
         # rest of the dintcal fixtures
         import test_dintcal
         return test_dintcal.broken_calib_findings()
+    if pname == "mut_check":
+        # the canonical broken mutation fixture (a killed cell flipped
+        # to survived => stale-provenance + survivor) lives with the
+        # rest of the dintmut fixtures
+        import test_dintmut
+        return test_dintmut.broken_mutcov_findings()
     raise AssertionError(pname)
 
 

@@ -501,14 +501,16 @@ def test_bench_plan_escape_hatch(monkeypatch):
 
 
 def test_dintgate_orchestration_smoke(tmp_path):
-    """Satellite: tools/dintgate.sh is ONE entry point for the six
-    standing gates. The smoke pins the orchestration — seven
+    """Satellite: tools/dintgate.sh is ONE entry point for the seven
+    standing gates. The smoke pins the orchestration — eight
     invocations (dintcal contributes check AND the journal audit) in
-    order through $PYTHON, dintplan full by default / static under
-    --quick, the five finding gates' SARIF logs merged into one
-    multi-run document, a failing gate named WITHOUT stopping the
-    others — against a millisecond stub; each real gate has its own
-    in-depth tests (and the full script runs in CI proper)."""
+    order through $PYTHON, the allowlist-rot dry-runs riding the three
+    matrix gates, dintplan full by default / static under --quick, the
+    six finding gates' SARIF logs merged into one multi-run document,
+    the per-stage wall-clock timings JSON line, a failing gate named
+    WITHOUT stopping the others — against a millisecond stub; each real
+    gate has its own in-depth tests (and the full script runs in CI
+    proper)."""
     import stat
     import subprocess
     import textwrap
@@ -538,17 +540,22 @@ def test_dintgate_orchestration_smoke(tmp_path):
     env = dict(os.environ, PYTHON=str(stub), CALLS=str(calls))
 
     merged = tmp_path / "gate.sarif"
-    r = subprocess.run(["bash", script, "--sarif", str(merged)],
+    timings = tmp_path / "timings.json"
+    r = subprocess.run(["bash", script, "--sarif", str(merged),
+                        "--timings", str(timings)],
                        capture_output=True, text=True, env=env,
                        timeout=120)
     assert r.returncode == 0, r.stdout + r.stderr
-    assert "all 6 gates ok" in r.stdout
+    assert "all 7 gates ok" in r.stdout
 
     lines = calls.read_text().splitlines()
     assert [ln.split()[0].rsplit("/", 1)[-1] for ln in lines] == \
         ["dintlint.py", "dintcost.py", "dintdur.py", "dintplan.py",
-         "dintmon.py", "dintcal.py", "dintcal.py"]
-    assert "--all" in lines[0] and "check --all" in lines[1]
+         "dintmon.py", "dintcal.py", "dintcal.py", "dintmut.py"]
+    # the three matrix gates carry the allowlist-rot dry-run
+    assert "--prune-allowlist --check" in lines[0]
+    assert "check --prune-allowlist --check" in lines[1]
+    assert "check --prune-allowlist --check" in lines[2]
     assert "--static" not in lines[3]        # default: the FULL gate
     assert lines[4].endswith("tests/fixtures/dintmon_counters.json")
     assert os.path.exists(os.path.join(
@@ -557,11 +564,25 @@ def test_dintgate_orchestration_smoke(tmp_path):
     assert lines[6].endswith("tests/fixtures/dintcal_journal.jsonl")
     assert os.path.exists(os.path.join(
         REPO, "tests", "fixtures", "dintcal_journal.jsonl"))
+    assert "check --quick" in lines[7]       # the dintmut sampled tier
 
     doc = json.loads(merged.read_text())
     assert doc["version"] == "2.1.0"
     assert sorted(r_["tool"]["driver"]["name"] for r_ in doc["runs"]) \
-        == ["dintcal", "dintcost", "dintdur", "dintlint", "dintplan"]
+        == ["dintcal", "dintcost", "dintdur", "dintlint", "dintmut",
+            "dintplan"]
+
+    # the per-stage wall-clock block: one JSON line, mirrored to --timings
+    tline = next(ln for ln in r.stdout.splitlines()
+                 if ln.startswith('{"metric": "dintgate"'))
+    tdoc = json.loads(tline)
+    assert tdoc == json.loads(timings.read_text())
+    assert [s["gate"] for s in tdoc["stages"]] == \
+        ["dintlint", "dintcost", "dintdur", "dintplan", "dintmon",
+         "dintcal", "dintcal-audit", "dintmut"]
+    assert all(s["ok"] is True and s["wall_s"] >= 0
+               for s in tdoc["stages"])
+    assert tdoc["quick"] is False and tdoc["total_s"] > 0
 
     # --quick keeps the planner gate static
     calls.write_text("")
@@ -576,7 +597,11 @@ def test_dintgate_orchestration_smoke(tmp_path):
                        env=dict(env, FAIL_DUR="1"), timeout=120)
     assert r.returncode == 1
     assert "dintgate: FAIL" in r.stdout and "dintdur" in r.stdout
-    assert len(calls.read_text().splitlines()) == 7   # no fail-fast
+    assert len(calls.read_text().splitlines()) == 8   # no fail-fast
+    tdoc = json.loads(next(ln for ln in r.stdout.splitlines()
+                           if ln.startswith('{"metric": "dintgate"')))
+    assert [s["gate"] for s in tdoc["stages"]
+            if s["ok"] is False] == ["dintdur"]
 
     # unknown flags are a usage error; --help documents the contract
     assert subprocess.run(["bash", script, "--frobnicate"],

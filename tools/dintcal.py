@@ -37,20 +37,14 @@ import os
 import sys
 from pathlib import Path
 
-# mesh targets need the same 8-device virtual CPU topology as
-# tests/conftest.py — pinned BEFORE jax initializes backends
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# the shared gate harness pins XLA_FLAGS (8-device virtual CPU) and
+# JAX_PLATFORMS before any backend initializes — see analysis/cli.py
+from dint_tpu.analysis import cli  # noqa: E402
 from dint_tpu.monitor import calib as CAL  # noqa: E402
 
-DEFAULT_ALLOWLIST = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                 "dintlint_allow.json")
+DEFAULT_ALLOWLIST = cli.DEFAULT_ALLOWLIST
 
 # bumped when keys of the --json payload change shape
 JSON_SCHEMA = 1
@@ -138,9 +132,7 @@ def cmd_check(args, ap) -> int:
     if args.calib:
         os.environ[CAL.ENV_CALIB_PATH] = args.calib
     anchor = os.environ.get(P.ENV_PLAN_ANCHOR, P.DEFAULT_ANCHOR)
-    allowlist = args.allowlist
-    if allowlist is None and os.path.exists(DEFAULT_ALLOWLIST):
-        allowlist = DEFAULT_ALLOWLIST
+    allowlist = cli.resolve_allowlist(args.allowlist)
 
     # half 1: the static calib_check pass (provenance, refit equality,
     # wave registry, plan model attribution) under the dintlint allowlist
@@ -183,29 +175,22 @@ def cmd_check(args, ap) -> int:
 
     failed = analysis.has_errors(findings)
     if args.sarif:
-        sarif = json.dumps(analysis.to_sarif(findings, ap.prog), indent=1)
-        if args.sarif == "-":
-            print(sarif, flush=True)
-        else:
-            with open(args.sarif, "w") as fh:
-                fh.write(sarif + "\n")
+        cli.write_sarif(findings, ap.prog, args.sarif)
     if args.json:
         print(json.dumps({
             "metric": "dintcal", "schema": JSON_SCHEMA, "mode": "check",
             "calib": str(cpath), "evidence": evidence_path,
             "anchor": anchor, "allowlist": allowlist,
             "n_findings": len(findings),
-            "n_errors": sum(f.severity == "error" and not f.suppressed
-                            for f in findings),
+            "n_errors": cli.count_errors(findings),
             "n_drift": len(drift), "ok": not failed,
             "findings": [f.to_dict() for f in findings]}), flush=True)
     else:
         for f in findings:
             print(f)
-        n_err = sum(f.severity == "error" and not f.suppressed
-                    for f in findings)
         print(f"dintcal check: {len(findings)} finding(s), "
-              f"{n_err} error(s), {len(drift)} drift(s) -> "
+              f"{cli.count_errors(findings)} error(s), "
+              f"{len(drift)} drift(s) -> "
               f"{'FAIL' if failed else 'ok'}", flush=True)
     return 1 if failed else 0
 
@@ -389,11 +374,7 @@ def main(argv=None) -> int:
     p.set_defaults(fn=cmd_synth)
 
     args = ap.parse_args(argv)
-    try:
-        return args.fn(args, ap)
-    except (OSError, ValueError) as e:
-        print(f"dintcal: {e}", file=sys.stderr)
-        return 2
+    return cli.guard("dintcal", args.fn, args, ap)
 
 
 if __name__ == "__main__":

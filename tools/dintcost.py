@@ -36,27 +36,16 @@ import json
 import os
 import sys
 
-# mesh targets need the same 8-device virtual CPU topology as
-# tests/conftest.py — pinned BEFORE jax initializes backends
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-
+# the shared gate harness pins XLA_FLAGS (8-device virtual CPU) and
+# JAX_PLATFORMS before any backend initializes — see analysis/cli.py
+from dint_tpu.analysis import cli  # noqa: E402
 from dint_tpu import analysis  # noqa: E402
-from dint_tpu.analysis import allowlist as al  # noqa: E402
 from dint_tpu.analysis import cost  # noqa: E402
 from dint_tpu.analysis import targets as T  # noqa: E402
 
-DEFAULT_ALLOWLIST = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                 "dintlint_allow.json")
+DEFAULT_ALLOWLIST = cli.DEFAULT_ALLOWLIST
 
 # bumped when keys of the --json payload change shape; bench artifacts
 # embed the report payload and the hw_round scripts archive it
@@ -172,47 +161,15 @@ def cmd_report(args, ap) -> int:
 def cmd_check(args, ap) -> int:
     if args.check and not args.prune_allowlist:
         ap.error("--check only modifies --prune-allowlist (dry-run)")
-    allowlist = args.allowlist
-    if allowlist is None and os.path.exists(DEFAULT_ALLOWLIST):
-        allowlist = DEFAULT_ALLOWLIST
+    allowlist = cli.resolve_allowlist(args.allowlist)
     stale = False
     if args.prune_allowlist:
         # gate-scoped prune: the full target matrix under ONLY this
         # gate's pass; only cost_budget entries can be judged stale here
         # (wildcard-pass entries belong to dintlint --prune-allowlist)
-        if args.target:
-            ap.error("--prune-allowlist needs the gate's full matrix: "
-                     "stale-entry detection over a subset run would drop "
-                     "entries whose findings simply were not traced "
-                     "(drop --target)")
-        if not allowlist or not os.path.exists(allowlist):
-            ap.error("--prune-allowlist: no allowlist file found "
-                     f"(looked for {allowlist or DEFAULT_ALLOWLIST})")
         names = sorted(T.TARGETS)
-        entries = al.load(allowlist)
-        findings = analysis.run(passes=["cost_budget"],
-                                allowlist_entries=entries)
-        kept, dropped = al.prune_scoped(entries, "cost_budget")
-        if dropped:
-            if args.check:
-                stale = True
-                print(f"{allowlist}: {len(dropped)} stale entr"
-                      f"{'y' if len(dropped) == 1 else 'ies'} "
-                      f"({len(kept)} kept) — file NOT rewritten "
-                      "(--check); run --prune-allowlist to fix:")
-            else:
-                al.save(allowlist, kept)
-                print(f"pruned {len(dropped)} stale entr"
-                      f"{'y' if len(dropped) == 1 else 'ies'} from "
-                      f"{allowlist} ({len(kept)} kept):")
-            for e in dropped:
-                print(f"  - {e['pass']}/{e['code']} "
-                      f"(target={e.get('target', '*')})")
-        else:
-            n_scoped = sum(e["pass"] == "cost_budget" for e in entries)
-            print(f"{allowlist}: all {n_scoped} cost_budget entr"
-                  f"{'y' if n_scoped == 1 else 'ies'} still match — "
-                  "nothing to prune")
+        findings, stale = cli.prune_scoped_gate(args, ap, "cost_budget",
+                                                allowlist)
     else:
         names = _target_names(args, ap)
         findings = analysis.run(targets=None if args.all else names,
@@ -220,30 +177,14 @@ def cmd_check(args, ap) -> int:
                                 allowlist_path=allowlist)
     failed = analysis.has_errors(findings) or stale
     if args.sarif:
-        sarif = json.dumps(analysis.to_sarif(findings, ap.prog), indent=1)
-        if args.sarif == "-":
-            print(sarif, flush=True)
-        else:
-            with open(args.sarif, "w") as fh:
-                fh.write(sarif + "\n")
+        cli.write_sarif(findings, ap.prog, args.sarif)
     if args.json:
-        print(json.dumps({
-            "metric": "dintcost", "schema": JSON_SCHEMA, "mode": "check",
-            "targets": names, "allowlist": allowlist,
-            "n_findings": len(findings),
-            "n_errors": sum(f.severity == "error" and not f.suppressed
-                            for f in findings),
-            "n_suppressed": sum(f.suppressed for f in findings),
-            "stale_allowlist": stale,
-            "ok": not failed,
-            "findings": [f.to_dict() for f in findings]}), flush=True)
+        print(json.dumps(cli.gate_payload(
+            "dintcost", JSON_SCHEMA, "check", names, allowlist,
+            findings, stale, failed)), flush=True)
     else:
-        for f in findings:
-            print(f)
-        n_err = sum(f.severity == "error" and not f.suppressed
-                    for f in findings)
-        print(f"dintcost: {len(findings)} finding(s), {n_err} error(s) "
-              f"-> {'FAIL' if failed else 'ok'}", flush=True)
+        cli.print_findings(findings, "dintcost", failed,
+                           show_suppressed=False)
     return 1 if failed else 0
 
 
@@ -385,11 +326,7 @@ def main(argv=None) -> int:
     p.set_defaults(fn=cmd_describe)
 
     args = ap.parse_args(argv)
-    try:
-        return args.fn(args, ap)
-    except (OSError, ValueError) as e:
-        print(f"dintcost: {e}", file=sys.stderr)
-        return 2
+    return cli.guard("dintcost", args.fn, args, ap)
 
 
 if __name__ == "__main__":

@@ -38,26 +38,15 @@ import json
 import os
 import sys
 
-# same 8-device virtual CPU topology as tests/conftest.py, pinned BEFORE
-# jax initializes backends (the mesh targets need it)
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-
+# the shared gate harness pins XLA_FLAGS (8-device virtual CPU) and
+# JAX_PLATFORMS before any backend initializes — see analysis/cli.py
+from dint_tpu.analysis import cli  # noqa: E402
 from dint_tpu import analysis  # noqa: E402
-from dint_tpu.analysis import allowlist as al  # noqa: E402
 from dint_tpu.analysis.passes import durability as _dur  # noqa: E402
 
-DEFAULT_ALLOWLIST = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                 "dintlint_allow.json")
+DEFAULT_ALLOWLIST = cli.DEFAULT_ALLOWLIST
 
 # bumped when keys of the --json payload change shape
 # schema 2: check payload carries stale_allowlist (--prune-allowlist)
@@ -157,16 +146,11 @@ def main(argv=None) -> int:
         ap.error("--prune-allowlist is a check-mode operation")
     if not args.all and not args.target and not args.prune_allowlist:
         ap.error("pick targets with --target/--all")
-    bad = [n for n in args.target if n not in analysis.TARGETS]
-    if bad:
-        lines = [f"unknown target {n!r}" for n in bad]
-        lines.append("registered targets:")
-        lines += [f"  {n}" for n in sorted(analysis.TARGETS)]
-        ap.error("\n".join(lines))
+    err = cli.check_names("target", args.target, analysis.TARGETS)
+    if err:
+        ap.error(err)
 
-    allowlist = args.allowlist
-    if allowlist is None and os.path.exists(DEFAULT_ALLOWLIST):
-        allowlist = DEFAULT_ALLOWLIST
+    allowlist = cli.resolve_allowlist(args.allowlist)
 
     stale = False
     if args.prune_allowlist:
@@ -174,38 +158,8 @@ def main(argv=None) -> int:
         # durability pass; only durability entries can be judged stale
         # here (wildcard-pass entries belong to dintlint
         # --prune-allowlist, the full-suite run)
-        if args.target:
-            ap.error("--prune-allowlist needs the gate's full matrix: "
-                     "stale-entry detection over a subset run would drop "
-                     "entries whose findings simply were not traced "
-                     "(drop --target)")
-        if not allowlist or not os.path.exists(allowlist):
-            ap.error("--prune-allowlist: no allowlist file found "
-                     f"(looked for {allowlist or DEFAULT_ALLOWLIST})")
-        entries = al.load(allowlist)
-        findings = analysis.run(passes=["durability"],
-                                allowlist_entries=entries)
-        kept, dropped = al.prune_scoped(entries, "durability")
-        if dropped:
-            if args.check:
-                stale = True
-                print(f"{allowlist}: {len(dropped)} stale entr"
-                      f"{'y' if len(dropped) == 1 else 'ies'} "
-                      f"({len(kept)} kept) — file NOT rewritten "
-                      "(--check); run --prune-allowlist to fix:")
-            else:
-                al.save(allowlist, kept)
-                print(f"pruned {len(dropped)} stale entr"
-                      f"{'y' if len(dropped) == 1 else 'ies'} from "
-                      f"{allowlist} ({len(kept)} kept):")
-            for e in dropped:
-                print(f"  - {e['pass']}/{e['code']} "
-                      f"(target={e.get('target', '*')})")
-        else:
-            n_scoped = sum(e["pass"] == "durability" for e in entries)
-            print(f"{allowlist}: all {n_scoped} durability entr"
-                  f"{'y' if n_scoped == 1 else 'ies'} still match — "
-                  "nothing to prune")
+        findings, stale = cli.prune_scoped_gate(args, ap, "durability",
+                                                allowlist)
     else:
         findings = analysis.run(
             targets=None if args.all else args.target,
@@ -215,37 +169,14 @@ def main(argv=None) -> int:
     failed = (args.mode == "check"
               and (analysis.has_errors(findings) or stale))
     if args.sarif:
-        sarif = json.dumps(analysis.to_sarif(findings, ap.prog), indent=1)
-        if args.sarif == "-":
-            print(sarif, flush=True)
-        else:
-            with open(args.sarif, "w") as fh:
-                fh.write(sarif + "\n")
+        cli.write_sarif(findings, ap.prog, args.sarif)
     if args.json:
-        print(json.dumps({
-            "metric": "dintdur",
-            "schema": JSON_SCHEMA,
-            "mode": args.mode,
-            "targets": (sorted(analysis.TARGETS) if args.all
-                        else args.target),
-            "allowlist": allowlist,
-            "stale_allowlist": stale,
-            "n_findings": len(findings),
-            "n_errors": sum(f.severity == "error" and not f.suppressed
-                            for f in findings),
-            "n_suppressed": sum(f.suppressed for f in findings),
-            "ok": not failed,
-            "findings": [f.to_dict() for f in findings],
-        }), flush=True)
+        print(json.dumps(cli.gate_payload(
+            "dintdur", JSON_SCHEMA, args.mode,
+            sorted(analysis.TARGETS) if args.all else args.target,
+            allowlist, findings, stale, failed)), flush=True)
     else:
-        for f in findings:
-            print(f)
-        n_err = sum(f.severity == "error" and not f.suppressed
-                    for f in findings)
-        n_sup = sum(f.suppressed for f in findings)
-        print(f"dintdur: {len(findings)} finding(s), {n_err} error(s), "
-              f"{n_sup} suppressed -> "
-              f"{'FAIL' if failed else 'ok'}", flush=True)
+        cli.print_findings(findings, "dintdur", failed)
     return 1 if failed else 0
 
 

@@ -37,41 +37,19 @@ import json
 import os
 import sys
 
-# the mesh targets need the same 8-device virtual CPU topology as
-# tests/conftest.py — and it must be pinned BEFORE jax initializes backends
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-
+# the shared gate harness pins XLA_FLAGS (8-device virtual CPU) and
+# JAX_PLATFORMS before any backend initializes — see analysis/cli.py
+from dint_tpu.analysis import cli  # noqa: E402
 from dint_tpu import analysis  # noqa: E402
 from dint_tpu.analysis import allowlist as al  # noqa: E402
 
-DEFAULT_ALLOWLIST = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                 "dintlint_allow.json")
+DEFAULT_ALLOWLIST = cli.DEFAULT_ALLOWLIST
 
 # bumped when keys of the --json payload change shape; bench artifacts
 # embed the payload and validate against this
 JSON_SCHEMA = 2
-
-
-def _check_names(kind, names, registry):
-    """Unknown --target/--pass = usage error (exit 2) listing what IS
-    registered, never a traceback."""
-    bad = [n for n in names if n not in registry]
-    if not bad:
-        return None
-    lines = [f"unknown {kind} {n!r}" for n in bad]
-    lines.append(f"registered {kind}s:")
-    lines += [f"  {n}" for n in sorted(registry)]
-    return "\n".join(lines)
 
 
 def _print_timing(timings: dict):
@@ -143,14 +121,12 @@ def main(argv=None) -> int:
     if not args.all and not args.target and not args.prune_allowlist:
         ap.error("pick targets with --target/--all (or --list to see them)")
 
-    err = (_check_names("target", args.target, analysis.TARGETS)
-           or _check_names("pass", args.passes, analysis.PASSES))
+    err = (cli.check_names("target", args.target, analysis.TARGETS)
+           or cli.check_names("pass", args.passes, analysis.PASSES))
     if err:
         ap.error(err)
 
-    allowlist = args.allowlist
-    if allowlist is None and os.path.exists(DEFAULT_ALLOWLIST):
-        allowlist = DEFAULT_ALLOWLIST
+    allowlist = cli.resolve_allowlist(args.allowlist)
 
     timings: dict = {}
     stale = False
@@ -198,12 +174,7 @@ def main(argv=None) -> int:
 
     failed = analysis.has_errors(findings) or stale
     if args.sarif:
-        sarif = json.dumps(analysis.to_sarif(findings, ap.prog), indent=1)
-        if args.sarif == "-":
-            print(sarif, flush=True)
-        else:
-            with open(args.sarif, "w") as fh:
-                fh.write(sarif + "\n")
+        cli.write_sarif(findings, ap.prog, args.sarif)
     if args.json:
         payload = {
             "metric": "dintlint",
@@ -214,9 +185,8 @@ def main(argv=None) -> int:
             "passes": args.passes or sorted(analysis.PASSES),
             "allowlist": allowlist,
             "n_findings": len(findings),
-            "n_errors": sum(f.severity == "error" and not f.suppressed
-                            for f in findings),
-            "n_suppressed": sum(f.suppressed for f in findings),
+            "n_errors": cli.count_errors(findings),
+            "n_suppressed": cli.count_suppressed(findings),
             "stale_allowlist": stale,
             "ok": not failed,
             "findings": [f.to_dict() for f in findings],
@@ -227,14 +197,12 @@ def main(argv=None) -> int:
     else:
         for f in findings:
             print(f)
-        n_err = sum(f.severity == "error" and not f.suppressed
-                    for f in findings)
-        n_sup = sum(f.suppressed for f in findings)
         if args.time:
             _print_timing(timings)
-        print(f"dintlint: {len(findings)} finding(s), {n_err} error(s), "
-              f"{n_sup} suppressed -> {'FAIL' if failed else 'ok'}",
-              flush=True)
+        print(f"dintlint: {len(findings)} finding(s), "
+              f"{cli.count_errors(findings)} error(s), "
+              f"{cli.count_suppressed(findings)} suppressed -> "
+              f"{'FAIL' if failed else 'ok'}", flush=True)
     return 1 if failed else 0
 
 

@@ -33,6 +33,9 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# dintmon never traces, but it shares the gate harness (exit-guard
+# discipline) with the other six CLIs — see analysis/cli.py
+from dint_tpu.analysis import cli                     # noqa: E402
 from dint_tpu.monitor import counters as ctr          # noqa: E402
 from dint_tpu.monitor import trace as tr              # noqa: E402
 
@@ -268,11 +271,9 @@ def main(argv=None) -> int:
     p.set_defaults(fn=cmd_describe)
 
     args = ap.parse_args(argv)
-    try:
-        return args.fn(args)
-    except OSError as e:
-        print(f"dintmon: {e}", file=sys.stderr)
-        return 2
+    # exc pinned to OSError only: dintmon's ValueErrors (malformed JSONL
+    # rows) have always surfaced as tracebacks, and tests pin that
+    return cli.guard("dintmon", args.fn, args, exc=(OSError,))
 
 
 if __name__ == "__main__":

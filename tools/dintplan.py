@@ -39,25 +39,15 @@ import json
 import os
 import sys
 
-# mesh targets need the same 8-device virtual CPU topology as
-# tests/conftest.py — pinned BEFORE jax initializes backends
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-
+# the shared gate harness pins XLA_FLAGS (8-device virtual CPU) and
+# JAX_PLATFORMS before any backend initializes — see analysis/cli.py
+from dint_tpu.analysis import cli  # noqa: E402
 from dint_tpu import analysis  # noqa: E402
 from dint_tpu.analysis import plan as P  # noqa: E402
 
-DEFAULT_ALLOWLIST = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                 "dintlint_allow.json")
+DEFAULT_ALLOWLIST = cli.DEFAULT_ALLOWLIST
 
 # bumped when keys of the --json payload change shape
 JSON_SCHEMA = 1
@@ -103,40 +93,30 @@ def cmd_check(args, ap) -> int:
     # the embedded pass defaults to static (cheap) — dintplan check is
     # the FULL gate, so force full mode unless --static asked for cheap
     os.environ[P.ENV_PLAN_STATIC] = "1" if args.static else "0"
-    allowlist = args.allowlist
-    if allowlist is None and os.path.exists(DEFAULT_ALLOWLIST):
-        allowlist = DEFAULT_ALLOWLIST
+    allowlist = cli.resolve_allowlist(args.allowlist)
     anchor = os.environ.get(P.ENV_PLAN_ANCHOR, P.DEFAULT_ANCHOR)
     findings = analysis.run(targets=[anchor], passes=["plan_check"],
                             allowlist_path=allowlist)
     failed = analysis.has_errors(findings)
     if args.sarif:
-        sarif = json.dumps(analysis.to_sarif(findings, ap.prog), indent=1)
-        if args.sarif == "-":
-            print(sarif, flush=True)
-        else:
-            with open(args.sarif, "w") as fh:
-                fh.write(sarif + "\n")
+        cli.write_sarif(findings, ap.prog, args.sarif)
     if args.json:
         print(json.dumps({
             "metric": "dintplan", "schema": JSON_SCHEMA, "mode": "check",
             "plan": str(P.plan_path()), "static": bool(args.static),
             "anchor": anchor, "allowlist": allowlist,
             "n_findings": len(findings),
-            "n_errors": sum(f.severity == "error" and not f.suppressed
-                            for f in findings),
-            "n_suppressed": sum(f.suppressed for f in findings),
+            "n_errors": cli.count_errors(findings),
+            "n_suppressed": cli.count_suppressed(findings),
             "ok": not failed,
             "findings": [f.to_dict() for f in findings]}), flush=True)
     else:
         for f in findings:
             print(f)
-        n_err = sum(f.severity == "error" and not f.suppressed
-                    for f in findings)
         mode = "static" if args.static else "full"
         print(f"dintplan ({mode}): {len(findings)} finding(s), "
-              f"{n_err} error(s) -> {'FAIL' if failed else 'ok'}",
-              flush=True)
+              f"{cli.count_errors(findings)} error(s) -> "
+              f"{'FAIL' if failed else 'ok'}", flush=True)
     return 1 if failed else 0
 
 
@@ -220,11 +200,7 @@ def main(argv=None) -> int:
     p.set_defaults(fn=cmd_describe)
 
     args = ap.parse_args(argv)
-    try:
-        return args.fn(args, ap)
-    except (OSError, ValueError) as e:
-        print(f"dintplan: {e}", file=sys.stderr)
-        return 2
+    return cli.guard("dintplan", args.fn, args, ap)
 
 
 if __name__ == "__main__":
