@@ -189,6 +189,7 @@ class ScatterRec:
     idx_rows: int = 0              # index batch width (0 = unknown)
     trips: float = 1.0             # product of enclosing scan lengths
     fused: bool = False            # synthetic scatter_streams record
+    unique_indices: bool = False   # the eqn's uniqueness certification
 
     @property
     def write_facts(self) -> frozenset:
@@ -592,7 +593,7 @@ class _Analyzer:
                 root=self._operand_root(tab, defs),
                 idx_nonconst=not self.is_const(idx),
                 idx_rows=int(shp[0]) if shp else 1, trips=self._mult,
-                fused=True)
+                fused=True, unique_indices=True)
 
     def _pallas_call(self, eqn, defs, path):
         name = self._kernel_name(eqn)
@@ -798,7 +799,9 @@ class _Analyzer:
                         root=self._operand_root(ins[0], defs),
                         idx_nonconst=(idx is not None
                                       and not self.is_const(idx)),
-                        idx_rows=rows, trips=self._mult)
+                        idx_rows=rows, trips=self._mult,
+                        unique_indices=bool(
+                            eqn.params.get("unique_indices")))
 
         out = frozenset(base | extra)
         for ov in eqn.outvars:

@@ -524,15 +524,18 @@ OPERATORS: dict[str, MutOp] = {op.name: op for op in [
     MutOp("drop-eqn",
           "delete a lock-arbitration / ppermute / log-append eqn",
           ("protocol/unlocked-install", "durability/quorum-fanout",
-           "protocol/no-replication-push", "durability/wal-order"),
+           "protocol/no-replication-push", "durability/wal-order",
+           "protocol/no-writer-election"),
           _find_drop_eqn),
     MutOp("weaken-scatter",
           "scatter-max -> overwrite; flip unique_indices certification",
-          ("scatter_race/nonunique-scatter", "protocol/unlocked-install"),
+          ("scatter_race/nonunique-scatter", "protocol/unlocked-install",
+           "protocol/uncertified-install", "protocol/no-writer-election"),
           _find_weaken_scatter),
     MutOp("mask-swap",
           "replace an install mask/index input with an unconstrained var",
-          ("protocol/unlocked-install", "protocol/unvalidated-install"),
+          ("protocol/unlocked-install", "protocol/unvalidated-install",
+           "protocol/unelected-install"),
           _find_mask_swap),
     MutOp("axis-swap",
           "ppermute dcn -> ici; collapse a perm's destinations",

@@ -40,6 +40,14 @@ pass fails closed on three checks (ANALYSIS.md "Static cost model"):
                           buffer (targets.OVERLAP_FOOTPRINT): the
                           in-flight cohort buffer is the ONLY extra state
                           the overlap is allowed to hold
+  scan-bytes-dominance    an @scan store target's sequential slab no
+                          longer derives STRICTLY fewer HBM bytes per
+                          reply row (dint.store.scan / (w*sl)) than its
+                          point twin pays per probe reply
+                          (dint.store.probe / w, targets.
+                          TARGET_SCAN_TWIN) — rows must arrive cheaper
+                          than probes, the dintscan bandwidth claim
+                          (round 20); no allowlist entries tolerated
 
 Every finding names the offending wave/target in `site` and is
 silenceable through the shared dintlint allowlist with a reviewed
@@ -237,6 +245,43 @@ def _overlap_findings(trace: TargetTrace,
     return out
 
 
+def _scan_dominance_findings(trace: TargetTrace,
+                             model: cost.CostModel) -> list[Finding]:
+    from .. import targets as T
+    twin = getattr(T, "TARGET_SCAN_TWIN", {}).get(trace.name)
+    if not twin or twin not in T.TARGETS:
+        return []
+    try:
+        twin_model = cost.model_for(twin)
+    except Exception:  # noqa: BLE001 — twin untraceable here (topology)
+        return []
+    if twin_model.error:
+        return []
+    geom = model.geom or {}
+    w, sl = float(geom.get("w", 0)), float(geom.get("sl", 0))
+    if w <= 0 or sl <= 0:
+        return []
+    scan_b = model.wave_bytes_per_step().get("dint.store.scan", 0.0)
+    probe_b = twin_model.wave_bytes_per_step().get("dint.store.probe",
+                                                   0.0)
+    per_row, per_probe = scan_b / (w * sl), probe_b / w
+    if scan_b <= 0.0 or per_row >= per_probe:
+        return [Finding(
+            "cost_budget", "scan-bytes-dominance", SEV_ERROR, trace.name,
+            f"{per_row:g} HBM bytes per reply row (dint.store.scan "
+            f"{scan_b:g} B/step over w*sl={w * sl:g} rows) vs the point "
+            f"twin {twin} at {per_probe:g} bytes per probe reply "
+            f"(dint.store.probe {probe_b:g} B/step over w={w:g} lanes): "
+            "sequential rows must arrive STRICTLY cheaper than point "
+            "probes — the dintscan bandwidth claim",
+            site=twin,
+            suggestion="the slab widened (check the sl+dc window and "
+                       "row stride) or the scan wave lost its scope — "
+                       f"diff `tools/dintcost.py report {trace.name} "
+                       f"{twin} --json`")]
+    return []
+
+
 @register_pass("cost_budget")
 def cost_budget(trace: TargetTrace) -> list[Finding]:
     """Derives the target's static cost model and enforces ledger
@@ -261,4 +306,5 @@ def cost_budget(trace: TargetTrace) -> list[Finding]:
     out += _dominance_findings(trace, model)
     out += _hier_dominance_findings(trace, model)
     out += _overlap_findings(trace, model)
+    out += _scan_dominance_findings(trace, model)
     return out

@@ -51,6 +51,24 @@ per-target protocol flags declared in analysis/targets.py:
       writes update, so a path that drops the push or discards the
       pushed payload fails deterministically.
 
+  writer-election      ["elected"]  lock-free server engines (the round-
+      20 KV store) arbitrate concurrent writers with the segment
+      machinery instead of a lock table: duplicate keys sort into
+      segments and a segment reduction (scatter-max over sorted seg_ids
+      — engines/store.step's last-writer-wins `seg_max_where`) elects
+      exactly one winner per key. Three ERROR checks pin that
+      discipline: (a) `no-writer-election` — the trace must contain at
+      least one non-pallas scatter-max/min whose indices carry SORTED
+      (deleting or overwrite-weakening the reduction removes the only
+      arbitration between duplicate writers); (b) `unelected-install` —
+      every overwrite scatter into persistent state must carry SORTED in
+      its write facts (indices/updates descend from the election, not an
+      unconstrained recomputation); (c) `uncertified-install` — each
+      such install must also declare ``unique_indices=True`` (the
+      one-writer claim stated to XLA; losing it both serializes the
+      scatter and silently drops the certification tests pin against
+      jaxlib lowering drift — see ops/segments.first_rank_where).
+
 Targets whose builders close no protocol loop in-trace declare fewer
 flags: `sharded/*` single-step servers execute client-driven ops (the
 coordinator in clients/ owns lock/validate/abort sequencing), so only
@@ -71,6 +89,7 @@ FLAG_OCC = "occ"
 FLAG_REPLICATED = "replicated"
 FLAG_DRAIN = "drain"
 FLAG_SERVER = "server"
+FLAG_ELECTED = "elected"
 
 
 def _installs(flow: df.Dataflow):
@@ -200,5 +219,53 @@ def protocol(trace: TargetTrace) -> list[Finding]:
                 suggestion="apply the ppermuted record to the backup "
                            "tables and append it to the local log "
                            "(parallel/dense_sharded._apply_backup)"))
+
+    if FLAG_ELECTED in flags:
+        elections = [r for r in flow.scatters
+                     if r.prim in ("scatter-max", "scatter-min")
+                     and not r.in_pallas
+                     and df.SORTED in r.index_facts]
+        if not elections:
+            out.append(Finding(
+                "protocol", "no-writer-election", SEV_ERROR, trace.name,
+                "lock-free server trace with no segment reduction: no "
+                "non-pallas scatter-max/min over SORTED indices exists, "
+                "so nothing arbitrates between duplicate writers to the "
+                "same key — last-writer-wins degrades to whichever lane "
+                "XLA happens to scatter last",
+                suggestion="elect one writer per key segment with "
+                           "ops/segments.seg_max_where over the sorted "
+                           "batch ranks, as engines/store.step's "
+                           "last_w_rank does"))
+        for r in installs:
+            if df.SORTED not in r.write_facts:
+                out.append(Finding(
+                    "protocol", "unelected-install", SEV_ERROR,
+                    trace.name,
+                    "overwrite scatter into persistent server state "
+                    "whose indices/updates carry no SORTED evidence: "
+                    "the write mask does not descend from the segment "
+                    "writer election, so duplicate or unelected lanes "
+                    "can install racing rows",
+                    primitive=r.prim, site=r.site, path="/".join(r.path),
+                    suggestion="route the install mask through the "
+                               "sorted-batch election "
+                               "(segments.sort_batch + seg_max_where) "
+                               "before scattering"))
+            elif not r.unique_indices:
+                out.append(Finding(
+                    "protocol", "uncertified-install", SEV_ERROR,
+                    trace.name,
+                    "elected install scatter without "
+                    "unique_indices=True: the one-writer-per-row claim "
+                    "is no longer stated to XLA, so the scatter "
+                    "serializes and the OOB-dup lowering contract the "
+                    "tests pin (segments.first_rank_where) is "
+                    "unguarded",
+                    primitive=r.prim, site=r.site, path="/".join(r.path),
+                    suggestion="restore unique_indices=True with "
+                               "mode='drop' on the masked install, as "
+                               "engines/store.step's table writes "
+                               "declare"))
 
     return out

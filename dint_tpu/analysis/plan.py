@@ -106,8 +106,8 @@ def override_active(environ=None) -> bool:
 # token order inside registered names ("@fused+hot", "@hot+pallas",
 # "@overlap+mon", "@h3+flat"): rank sorts tokens into the registry's
 # canonical spelling
-_TOKEN_RANK = {"fused": 0, "hot": 1, "h3": 2, "overlap": 3, "mon": 4,
-               "pallas": 5, "flat": 6, "trace": 7}
+_TOKEN_RANK = {"fused": 0, "hot": 1, "h3": 2, "overlap": 3, "scan": 4,
+               "mon": 5, "pallas": 6, "flat": 7, "trace": 8}
 
 _DENSE = ("tatp_dense", "smallbank_dense")
 _SHARDED = ("dense_sharded", "dense_sharded_sb")
@@ -164,6 +164,13 @@ _KNOB_LIST = (
          _MESH, token="overlap", planned=True,
          doc="double-buffer the DCN exchange under the lock wave "
              "(round 18 serve plane)"),
+    Knob("use_scan", "DINT_USE_SCAN", "flag01", False, (False, True),
+         ("store",), token="scan", planned=False, build_identity=True,
+         doc="thread the round-20 ordered-run snapshot + delta overlay "
+             "through the store step (Op.SCAN range replies via the "
+             "sequential slab); not planned — default-off until the "
+             "round-20 hw A/B shows the GB/s win (PERF.md decision "
+             "rule), priced by the calibrated @scan targets"),
     Knob("monitor", "DINT_MONITOR", "flag1", False, (False, True),
          _DENSE + _SHARDED + _MESH, token="mon",
          doc="thread the dintmon counter plane through the carry; "

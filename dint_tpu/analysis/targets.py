@@ -1073,6 +1073,10 @@ _MHSB_TRACE = {"dint.multihost_sb.route": "2*2*w*l*12"}
 _MH_EXPECT = {"dint.tatp_dense.log_append": "2*w*(20 + 4*vw)"}
 
 
+# Every @mon footprint below includes the round-20 counter-plane growth:
+# the scan_requests/scan_rows/scan_delta_hits rows widen the device
+# Counters leaf by 12 B per device (3 x u32), +12 B single-chip, +12*d
+# on the sharded/mesh targets — a fleet-wide recalibration, not a leak.
 def _cost(geom, dispatches, footprint, *, steps=float(_BLK),
           bytes_budget="1.25*ledger", wave_expect=None):
     return dict(steps=float(steps), geom=dict(geom),
@@ -1086,8 +1090,8 @@ TARGET_COST.update({
     # -> 7 (@pallas) -> 4 (@fused) dispatches/step, bytes flat
     "tatp_dense/block": _cost(_TD_GEOM, 9, 216844),
     "tatp_dense/block@pallas": _cost(_TD_GEOM, 7, 216844),
-    "tatp_dense/block@mon": _cost(_TD_GEOM, 11, 216980),
-    "tatp_dense/block@mon+pallas": _cost(_TD_GEOM, 10, 216980,
+    "tatp_dense/block@mon": _cost(_TD_GEOM, 11, 216992),
+    "tatp_dense/block@mon+pallas": _cost(_TD_GEOM, 10, 216992,
                                          wave_expect=_MONPL_TD),
     "tatp_dense/drain": _cost(_TD_GEOM, 9, 216836),
     "tatp_dense/block@hot": _cost(_TD_GEOM, 13, 216864,
@@ -1097,34 +1101,34 @@ TARGET_COST.update({
     # closed-loop rows above (the occupancy mask fuses into the gen
     # wave), footprint +16 B (@mon +28 B) for the occ/shed step inputs
     "tatp_dense/serve": _cost(_TD_GEOM, 9, 216860),
-    "tatp_dense/serve@mon": _cost(_TD_GEOM, 11, 216996),
+    "tatp_dense/serve@mon": _cost(_TD_GEOM, 11, 217008),
     "tatp_dense/block@fused": _cost(_TD_GEOM, 4, 216844),
     "tatp_dense/block@fused+hot": _cost(_TD_GEOM, 5, 216864,
                                         wave_expect=_TD_FUSED_HOT),
-    "tatp_dense/block@fused+mon": _cost(_TD_GEOM, 7, 216980),
+    "tatp_dense/block@fused+mon": _cost(_TD_GEOM, 7, 216992),
     # dense SmallBank: 8 -> 5 dispatches/step under the megakernels
     "smallbank_dense/block": _cost(_SB_GEOM, 8, 150984),
     "smallbank_dense/block@pallas": _cost(_SB_GEOM, 8, 150984),
-    "smallbank_dense/block@mon": _cost(_SB_GEOM, 10, 151120),
+    "smallbank_dense/block@mon": _cost(_SB_GEOM, 10, 151132),
     "smallbank_dense/block@hot": _cost(_SB_GEOM, 14, 151032,
                                        wave_expect=_HOT2_SB),
     "smallbank_dense/block@hot+pallas": _cost(_SB_GEOM, 10, 151032),
-    "smallbank_dense/block@hot+mon": _cost(_SB_GEOM, 16, 151168,
+    "smallbank_dense/block@hot+mon": _cost(_SB_GEOM, 16, 151180,
                                            wave_expect=_HOT2_SB),
     "smallbank_dense/serve": _cost(_SB_GEOM, 8, 151000),
-    "smallbank_dense/serve@mon": _cost(_SB_GEOM, 10, 151136),
+    "smallbank_dense/serve@mon": _cost(_SB_GEOM, 10, 151148),
     "smallbank_dense/block@fused": _cost(_SB_GEOM, 5, 150984),
     "smallbank_dense/block@fused+hot": _cost(_SB_GEOM, 7, 151032),
-    "smallbank_dense/block@fused+mon": _cost(_SB_GEOM, 7, 151120),
+    "smallbank_dense/block@fused+mon": _cost(_SB_GEOM, 7, 151132),
     # generic pipelines: sort-bound, no formula-backed waves -> absolute
     # bytes ceilings instead of a ledger multiple
     "tatp_pipeline/block": _cost(_TD_GEOM, 50, 1610736022,
                                  bytes_budget=256000),
-    "tatp_pipeline/block@mon": _cost(_TD_GEOM, 51, 1610736158,
+    "tatp_pipeline/block@mon": _cost(_TD_GEOM, 51, 1610736170,
                                      bytes_budget=256000),
     "smallbank_pipeline/block": _cost(_SB_GEOM, 36, 1207967480,
                                       bytes_budget=72000),
-    "smallbank_pipeline/block@mon": _cost(_SB_GEOM, 37, 1207967616,
+    "smallbank_pipeline/block@mon": _cost(_SB_GEOM, 37, 1207967628,
                                           bytes_budget=72000),
     # generic replicated shard step: one engine step per trace
     "sharded/tatp": _cost(_DS_GEOM, 62, 4295279296, steps=1.0,
@@ -1136,21 +1140,21 @@ TARGET_COST.update({
                                  wave_expect=_DS_EXPECT),
     "dense_sharded/block@pallas": _cost(_DS_GEOM, 31, 459240,
                                         wave_expect=_DS_EXPECT),
-    "dense_sharded/block@mon": _cost(_DS_GEOM, 37, 459784,
+    "dense_sharded/block@mon": _cost(_DS_GEOM, 37, 459832,
                                      wave_expect=_DS_EXPECT),
     "dense_sharded/block@fused": _cost(_DS_GEOM, 28, 459240,
                                        wave_expect=_DS_EXPECT_FUSED),
-    "dense_sharded/block@fused+mon": _cost(_DS_GEOM, 33, 459784,
+    "dense_sharded/block@fused+mon": _cost(_DS_GEOM, 33, 459832,
                                            wave_expect=_DS_EXPECT_FUSED),
     # dense multi-chip SmallBank: 33 -> 30 dispatches/step fused
     "dense_sharded_sb/block": _cost(_DSB_GEOM, 33, 100676560),
-    "dense_sharded_sb/block@mon": _cost(_DSB_GEOM, 37, 100677104),
+    "dense_sharded_sb/block@mon": _cost(_DSB_GEOM, 37, 100677152),
     "dense_sharded_sb/block@hot": _cost(_DSB_GEOM, 39, 100676848,
                                         wave_expect=_DSB_HOT),
     "dense_sharded_sb/block@fused": _cost(_DSB_GEOM, 30, 100676560),
     "dense_sharded_sb/block@fused+hot": _cost(
         _DSB_GEOM, 32, 100676848, wave_expect=_DSB_FUSED_HOT),
-    "dense_sharded_sb/block@fused+mon": _cost(_DSB_GEOM, 34, 100677104),
+    "dense_sharded_sb/block@fused+mon": _cost(_DSB_GEOM, 34, 100677152),
     # 2-D (dcn x ici) SmallBank: the hierarchical route pays +9
     # dispatches/step (each exchange runs ici + dcn stages) to move
     # strictly fewer DCN-axis link bytes than its flat twin — the
@@ -1159,7 +1163,7 @@ TARGET_COST.update({
     "multihost_sb/block": _cost(_MHSB_GEOM, 42, 201353056),
     "multihost_sb/block@flat": _cost(_MHSB_GEOM, 33, 201353056,
                                      wave_expect=_MHSB_FLAT),
-    "multihost_sb/block@mon": _cost(_MHSB_GEOM, 46, 201354144),
+    "multihost_sb/block@mon": _cost(_MHSB_GEOM, 46, 201354240),
     "multihost_sb/block@h3": _cost(_MHSB_GEOM_H3, 42, 151014808),
     "multihost_sb/block@h3+flat": _cost(_MHSB_GEOM_H3, 33, 151014808,
                                         wave_expect=_MHSB_FLAT),
@@ -1172,9 +1176,9 @@ TARGET_COST.update({
     "multihost_sb/serve": _cost(_MHSB_GEOM, 42, 201353184),
     "multihost_sb/serve@flat": _cost(_MHSB_GEOM, 33, 201353184,
                                      wave_expect=_MHSB_FLAT),
-    "multihost_sb/serve@mon": _cost(_MHSB_GEOM, 47, 201354272),
+    "multihost_sb/serve@mon": _cost(_MHSB_GEOM, 47, 201354368),
     "multihost_sb/serve@overlap": _cost(_MHSB_GEOM, 44, 201359424),
-    "multihost_sb/serve@overlap+mon": _cost(_MHSB_GEOM, 50, 201360512),
+    "multihost_sb/serve@overlap+mon": _cost(_MHSB_GEOM, 50, 201360608),
     # 2-D TATP (parallel/multihost.py, flat tuple-axis collectives):
     # replication traffic pre-dates wave scoping -> absolute bytes
     # ceiling like the pipeline targets, not a ledger multiple
@@ -1201,6 +1205,135 @@ TARGET_COST.update({
                                       steps=1.0, bytes_budget=10240),
     "recovery/sb_shard": _cost(dict(w=_W, l=3, vw=2, d=_MESH_SHARDS), 1,
                                50248, steps=1.0, bytes_budget=10240),
+})
+
+
+# --------------------------------------- dintscan store serving (round 20)
+# The KV store engine as a serve family: point GET/SET batches plus the
+# @scan variants threading the ordered-run snapshot + delta overlay
+# (Op.SCAN answered by the sequential slab, dint.store.scan). protocol
+# is ('server', 'elected'): the store executes client-driven ops — no
+# in-trace lock/validate loop to certify; instead the 'elected' flag
+# pins the lock-free discipline itself (protocol pass, round 20): the
+# segment writer election must exist, every install must descend from
+# it, and every install must certify unique_indices — the three checks
+# that make dintmut's store/block@scan cells killable.
+
+_ST_NB = 16            # 16 buckets x 4 slots = 64 entries (= run cap)
+_ST_SMAX = 8           # scan_max: reply slab rows per lane
+_ST_DCAP = 8           # delta overlay capacity (window = sl + dc rows)
+# lg = locate rounds = bit_length(cap=64) = 7 (tables/run.locate_bits)
+_ST_GEOM = dict(w=_W, vw=_VW, sl=_ST_SMAX, dc=_ST_DCAP, lg=7)
+
+
+def _store_runner(name: str, use_scan: bool, use_pallas: bool = False,
+                  monitor: bool = False, serve: bool = False
+                  ) -> TargetTrace:
+    from ..engines import store
+    from ..tables import kv
+    run, init, _ = store.build_serve_runner(
+        _N_ACCT, w=_W, cohorts_per_block=_BLK, val_words=_VW,
+        scan_frac=0.5 if use_scan else 0.0, max_scan_len=_ST_SMAX,
+        scan_max=_ST_SMAX, delta_cap=_ST_DCAP, use_scan=use_scan,
+        use_pallas=use_pallas, monitor=monitor, serve=serve)
+    carry = _abstract(lambda: init(kv.create(_ST_NB, val_words=_VW)))
+    args = (carry, _key_aval())
+    if serve:
+        args += (_occ_aval(), _occ_aval())
+    return trace_target(name, run, args)
+
+
+@register_target("store/block",
+                 "KV store block, point ops only (GET/SET mix): the "
+                 "packet-at-a-time baseline the scan route must beat",
+                 protocol=('server', 'elected'))
+def _t_store_block() -> TargetTrace:
+    return _store_runner("store/block", use_scan=False)
+
+
+@register_target("store/block@scan",
+                 "KV store block with the ordered-run scan path: locate "
+                 "+ sequential slab + run∪delta merge, XLA slab route",
+                 protocol=('server', 'elected'))
+def _t_store_block_scan() -> TargetTrace:
+    return _store_runner("store/block@scan", use_scan=True)
+
+
+@register_target("store/block@scan+pallas",
+                 "KV store scans through the sequential-DMA scan_rows "
+                 "kernel (offset-sorted double-buffered row streams)",
+                 protocol=('server', 'elected'))
+def _t_store_block_scan_pl() -> TargetTrace:
+    return _store_runner("store/block@scan+pallas", use_scan=True,
+                         use_pallas=True)
+
+
+@register_target("store/serve@scan",
+                 "KV store serve-mode block: variable-occupancy mask "
+                 "over the scan-enabled step (dintserve steady state)",
+                 protocol=('server', 'elected'))
+def _t_store_serve_scan() -> TargetTrace:
+    return _store_runner("store/serve@scan", use_scan=True, serve=True)
+
+
+@register_target("store/serve@scan+mon",
+                 "KV store serve-mode block with the counter plane: "
+                 "scan_requests/scan_rows/scan_delta_hits on the ledger",
+                 protocol=('server', 'elected'))
+def _t_store_serve_scan_mon() -> TargetTrace:
+    return _store_runner("store/serve@scan+mon", use_scan=True,
+                         serve=True, monitor=True)
+
+
+@register_target("store/rebuild@scan",
+                 "drain-boundary merge-compact: delta overlay folded "
+                 "back into the dense sorted run (dint.store.run_rebuild)",
+                 # no 'elected': this trace is the maintenance compact
+                 # alone — no step loop, so no election/installs to pin
+                 protocol=('server',))
+def _t_store_rebuild() -> TargetTrace:
+    from ..engines import store
+    from ..tables import kv
+    from ..tables import run as run_mod
+    table = _abstract(lambda: kv.create(_ST_NB, val_words=_VW))
+    runv = _abstract(lambda: run_mod.from_table(
+        kv.create(_ST_NB, val_words=_VW), delta_cap=_ST_DCAP))
+    return trace_target("store/rebuild@scan", jax.jit(store.rebuild_run),
+                        (table, runv))
+
+
+# @scan targets -> their point-op twin: passes/cost_budget.py fails
+# scan-bytes-dominance unless the sequential slab derives STRICTLY
+# fewer HBM bytes per REPLY ROW (dint.store.scan bytes / (w*sl)) than
+# the point route pays per reply (dint.store.probe bytes / w) — rows
+# must arrive cheaper than probes, the dintscan bandwidth claim
+TARGET_SCAN_TWIN: dict[str, str] = {
+    "store/block@scan": "store/block",
+    "store/block@scan+pallas": "store/block",
+    "store/serve@scan": "store/block",
+}
+
+# round-20 dintscan store cost rows. probe/install bytes are hash-
+# layout-dependent (unmodeled, attribution-only waves) -> absolute
+# bytes ceilings like the pipeline targets, ~5% over the calibrated
+# trace. The modeled pair reconciles EXACTLY at this geometry: scan =
+# w*(sl+dc)*(12+4*vw) = 7168 B/step, scan_locate = w*lg*8 = 896 B/step
+# (zero wave_expect entries, zero allowlist entries — ISSUE 20's
+# acceptance). The run_rebuild wave bills once per BLOCK (the drain
+# boundary), attribution-only. @scan+pallas keeps the identical bytes
+# (same logical rows) and drops 3 dispatches/step: the 4 slab gathers
+# fuse into 1 scan_rows kernel (+1 offset argsort feed). The mon row
+# pays +1 dispatch and +32 B/step for the counter scatter-add.
+TARGET_COST.update({
+    "store/block": _cost(_ST_GEOM, 15, 2008, bytes_budget=2200),
+    "store/block@scan": _cost(_ST_GEOM, 35.5, 4077, bytes_budget=11700),
+    "store/block@scan+pallas": _cost(_ST_GEOM, 32.5, 4077,
+                                     bytes_budget=11700),
+    "store/serve@scan": _cost(_ST_GEOM, 35.5, 4093, bytes_budget=11700),
+    "store/serve@scan+mon": _cost(_ST_GEOM, 36.5, 4241,
+                                  bytes_budget=11750),
+    "store/rebuild@scan": _cost(_ST_GEOM, 5, 6122, steps=1.0,
+                                bytes_budget=1950),
 })
 
 
@@ -1235,6 +1368,12 @@ MUT_TARGETS: dict[str, tuple[str, ...]] = {
     # 2-D (dcn x ici) mesh: the only target where dcn->ici rerouting is
     # expressible — the axis-swap dcn variant lives here
     "multihost_sb/block": ("drop-eqn", "axis-swap", "ring-shrink"),
+    # round-20 scan-enabled store: no lock ring / replication, but the
+    # writer-election scatters, the scan merge masks and the slab
+    # gathers are all corruptible — the gate matrix must prove the
+    # oracle pins and the cost ledger actually catch them
+    "store/block@scan": ("drop-eqn", "weaken-scatter", "mask-swap",
+                         "widen-gather"),
 }
 
 

@@ -88,18 +88,46 @@ class StoreClient(_SteppedClient):
     attaches the dintcache mirror for the first ``hot_frac`` of the
     keyspace and threads it through every step (write-through,
     bit-identical replies); DINT_USE_PALLAS additionally serves the
-    partition with the VMEM hot kernels."""
+    partition with the VMEM hot kernels.
+
+    ``use_scan`` (None = DINT_USE_SCAN env) attaches the dintscan ordered
+    run and lets waves carry Op.SCAN lanes (``scan_frac`` of the mix,
+    zipfian start keys + uniform lengths clipped to ``scan_max``).
+    In-doubt/retry semantics match the GET path's populated-key asserts:
+    a scan must answer VAL, except when the run's overlay went stale —
+    then the engine replies RETRY, the client rebuilds the run at the
+    next maintenance point and RE-SENDS exactly those lanes, and the
+    retry must answer VAL (the capped-resend discipline of the TIMEOUT
+    sentinel, here with the rebuild as the recovery action)."""
 
     def __init__(self, table: kv.KVTable, n_keys: int, width: int = 4096,
                  val_words: int = 10, read_frac: float = 0.5,
                  key_dist: str = "uniform", zipf_theta: float = wl.ZIPF_THETA,
                  hot_frac: float | None = None, use_hotset=None,
-                 use_pallas=None):
+                 use_pallas=None, use_scan=None, scan_frac: float = 0.0,
+                 scan_max: int = 8, max_scan_len: int | None = None,
+                 delta_cap: int = 64, rebuild_every: int = 8):
         from ..ops import pallas_gather as pg
+        from ..tables import run as run_mod
 
         assert key_dist in ("uniform", "zipfian")
         self.use_hotset = pg.resolve_use_hotset(use_hotset)
+        self.use_scan = pg.resolve_use_scan(use_scan)
+        self.scan_max = int(scan_max)
+        self.scan_frac = float(scan_frac) if self.use_scan else 0.0
+        self.max_scan_len = int(max_scan_len or scan_max)
+        self.delta_cap = int(delta_cap)
+        self.rebuild_every = max(int(rebuild_every), 1)
+        self._waves_since_rebuild = 0
         up = pg.resolve_use_pallas(use_pallas, n_idx=width, m_lock=None)
+        run0 = None
+        if self.use_scan:
+            run0 = run_mod.from_table(table, delta_cap=int(delta_cap))
+            if up and not pg.scan_kernels_available(
+                    n_idx=width, lg=self.scan_max + run0.delta_cap,
+                    vw=val_words):
+                up = False
+        hot = None
         if self.use_hotset:
             if up and not pg.hot_kernels_available(n_idx=width):
                 up = False
@@ -109,6 +137,24 @@ class StoreClient(_SteppedClient):
             hot_n = min(int(n_keys * frac) + 1, n_keys + 1)
             hot = store.attach_hot(table, hot_n)
 
+        smax = self.scan_max
+        if self.use_scan and self.use_hotset:
+            def step_fn(state, batch, _up=up):
+                t, h, rn = state
+                t, rep, h, rn, srep = store.step(
+                    t, batch, hot=h, use_pallas=_up, run=rn, scan_max=smax)
+                return (t, h, rn), (rep, srep)
+
+            state = (table, hot, run0)
+        elif self.use_scan:
+            def step_fn(state, batch, _up=up):
+                t, rn = state
+                t, rep, rn, srep = store.step(
+                    t, batch, use_pallas=_up, run=rn, scan_max=smax)
+                return (t, rn), (rep, srep)
+
+            state = (table, run0)
+        elif self.use_hotset:
             def step_fn(state, batch, _up=up):
                 t, h = state
                 t, rep, h = store.step(t, batch, hot=h, use_pallas=_up)
@@ -118,6 +164,12 @@ class StoreClient(_SteppedClient):
         else:
             state, step_fn = table, store.step
         super().__init__(state, step_fn, width, val_words)
+        if self.use_scan:
+            def _rebuild(state):
+                t, rest = state[0], state[1:]
+                return (t,) + rest[:-1] + (store.rebuild_run(t, rest[-1]),)
+
+            self._rebuild = jax.jit(_rebuild, donate_argnums=0)
         self.n_keys = n_keys
         self.read_frac = read_frac
         self.key_dist = key_dist
@@ -136,20 +188,68 @@ class StoreClient(_SteppedClient):
             return wl.zipf_keys(rng, n, self.n_keys, self.zipf_theta)
         return rng.integers(1, self.n_keys + 1, size=n).astype(np.uint64)
 
+    def _wave_scan(self, ops, keys, vals, vers):
+        """Like _wave, for the scan-threaded step whose reply is
+        (Replies, ScanReplies)."""
+        m = len(ops)
+        assert m <= self.width, f"wave of {m} exceeds width {self.width}"
+        batch = make_batch(ops, keys, vals, vers=vers,
+                           width=self.width, val_words=self.vw)
+        t0 = time.monotonic()
+        self.state, (rep, srep) = self._step(self.state, batch)
+        rt = np.asarray(rep.rtype)[:m]
+        dt = time.monotonic() - t0
+        self.rec.device_busy_s += dt
+        return rt, np.asarray(rep.val)[:m], np.asarray(rep.ver)[:m], srep, dt
+
     def run_wave(self, rng: np.random.Generator, n: int | None = None):
         n = n or self.width
         keys = self._keys(rng, n)
-        is_read = rng.random(n) < self.read_frac
-        ops = np.where(is_read, Op.GET, Op.SET).astype(np.int32)
+        is_scan = rng.random(n) < self.scan_frac
+        is_read = ~is_scan & (rng.random(n) < self.read_frac)
+        ops = np.where(is_scan, Op.SCAN,
+                       np.where(is_read, Op.GET, Op.SET)).astype(np.int32)
         vals = np.zeros((n, self.vw), np.uint32)
         vals[:, 0] = rng.integers(0, 1 << 30, size=n).astype(np.uint32)
         vals[:, 1] = STORE_MAGIC
-        rt, rv, _, dt = self._wave(ops, keys, vals)
+        srep = None
+        if self.use_scan:
+            vers = np.where(is_scan,
+                            wl.scan_lengths(rng, n, self.max_scan_len),
+                            0).astype(np.uint32)
+            rt, rv, rr, srep, dt = self._wave_scan(ops, keys, vals, vers)
+        else:
+            assert not is_scan.any(), "scan lanes need use_scan=True"
+            rt, rv, rr, dt = self._wave(ops, keys, vals)
         got = rt[is_read] == Reply.VAL
         assert got.all(), "populated key missing"
         assert (rv[is_read][:, 1] == STORE_MAGIC).all(), "magic corrupted"
         ok = int((rt == Reply.VAL).sum() + (rt == Reply.ACK).sum())
+        if self.use_scan:
+            sc = rt[is_scan]
+            assert np.isin(sc, (Reply.VAL, Reply.RETRY)).all(), \
+                "scan lane answered neither VAL nor RETRY"
+            cnt = np.asarray(srep.count)[:n]
+            okv = is_scan & (rt == Reply.VAL)
+            assert (cnt[okv] <= np.minimum(vers[okv], self.scan_max)).all()
+            assert (rr[okv] == cnt[okv]).all()
+            retry = is_scan & (rt == Reply.RETRY)
+            if retry.any():
+                # in-doubt recovery, GET-path style: the stale overlay is
+                # the known cause, so rebuild NOW and re-send exactly the
+                # RETRY lanes — the retry must answer VAL
+                self.state = self._rebuild(self.state)
+                self._waves_since_rebuild = 0
+                rt2, _, rr2, srep2, _ = self._wave_scan(
+                    ops[retry], keys[retry], vals[retry], vers[retry])
+                assert (rt2 == Reply.VAL).all(), "scan retry still in doubt"
+                ok += int(len(rt2))
         self.rec.record(n, ok, np.full(n, dt * 1e6))
+        self._waves_since_rebuild += 1
+        if self.use_scan and self._waves_since_rebuild >= self.rebuild_every:
+            # drain-boundary maintenance: fold the overlay into the run
+            self.state = self._rebuild(self.state)
+            self._waves_since_rebuild = 0
         return ok
 
 

@@ -83,6 +83,51 @@ def zipf_keys(rng: np.random.Generator, n: int, n_keys: int,
     return np.clip(k, 1, n_keys).astype(np.uint64)
 
 
+# ----------------------------------------------------------- dintscan / YCSB-E
+
+
+def scan_lengths(rng: np.random.Generator, n: int, max_len: int,
+                 min_len: int = 1) -> np.ndarray:
+    """Uniform scan lengths in [min_len, max_len] — YCSB-E's default
+    request-distribution for scan length (uniform over [1, max]); the
+    engine additionally clips to its static scan_max slab width."""
+    assert 1 <= min_len <= max_len
+    return rng.integers(min_len, max_len + 1, size=n).astype(np.uint32)
+
+
+def zipf_scan_starts(rng: np.random.Generator, n: int, n_keys: int,
+                     theta: float = ZIPF_THETA) -> np.ndarray:
+    """YCSB-E start keys: zipfian over the keyspace, same rank == key-id
+    alignment as zipf_keys — scans over the hot head of the ordered run
+    touch the same rows repeatedly, the scan analogue of the point
+    workloads' cacheable skew."""
+    return zipf_keys(rng, n, n_keys, theta)
+
+
+# YCSB-E: 95% scans / 5% inserts (upserts here); YCSB-B: 95/5 read/update.
+YCSB_E_SCAN_FRAC = 0.95
+YCSB_E_MAX_SCAN = 100
+
+
+def ycsb_e_ops(rng: np.random.Generator, n: int, n_keys: int,
+               scan_frac: float = YCSB_E_SCAN_FRAC,
+               max_len: int = YCSB_E_MAX_SCAN,
+               theta: float = ZIPF_THETA):
+    """One YCSB-E-shaped cohort for the store engine: scans with zipfian
+    start keys + uniform lengths, the remainder upsert writes.
+
+    Returns (is_scan [n] bool, keys [n] u64, scan_len [n] u32 — zero on
+    write lanes). Deterministic per rng state (tests/test_workloads.py).
+    """
+    is_scan = rng.random(n) < scan_frac
+    starts = zipf_scan_starts(rng, n, n_keys, theta)
+    writes = zipf_keys(rng, n, n_keys, theta)
+    keys = np.where(is_scan, starts, writes)
+    lens = np.where(is_scan, scan_lengths(rng, n, max_len), 0) \
+        .astype(np.uint32)
+    return is_scan, keys, lens
+
+
 # ---------------------------------------------------------------- tatp
 
 TATP_GET_SUBSCRIBER = 0

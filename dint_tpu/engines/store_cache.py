@@ -157,7 +157,11 @@ def cache_step(cache: CacheTable, batch: Batch, *, policy: str = WB_BLOOM,
     local_get = is_get & (hit0 | absent)
     local_set = is_set & hit0 if policy != WT else jnp.zeros((r,), bool)
     local = local_get | local_set
-    # INSERT/DELETE and anything else defers to the host
+    # INSERT/DELETE and anything else — including Op.SCAN (round-20
+    # dintscan): range scans need the ORDERED run over the full
+    # keyspace, which only the authoritative store owns; a cache holds
+    # an arbitrary working-set subset, so scan lanes always defer and
+    # the host resolves them against the backing KVS — defers to the host
     lane_miss = used & ~local
     # whole-segment deferral: one miss lane defers its key's every lane
     seg_miss = segments.seg_any(sb, lane_miss)

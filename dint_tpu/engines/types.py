@@ -58,6 +58,12 @@ class Op:
     INSERT_BCK = 23
     DELETE_BCK = 24
     DELETE_LOG = 25
+    # dintscan range scan over the ordered run (tables/run.py): key is the
+    # start key, `ver` carries the requested row count (clipped to the
+    # engine's static scan_max). Replies land in ScanReplies; the lane's
+    # Replies slot carries VAL + the row count in `ver` (or RETRY when the
+    # run overlay is stale and the scan must be re-sent after a rebuild).
+    SCAN = 26
 
 
 class Reply:
@@ -106,6 +112,25 @@ class Replies:
     rtype: jax.Array    # i32 [R]
     val: jax.Array      # u32 [R, VW]
     ver: jax.Array      # u32 [R]
+
+
+@flax.struct.dataclass
+class ScanReplies:
+    """Row slabs for Op.SCAN lanes (zero rows for non-scan lanes).
+
+    Rows are the first `count` live keys >= the lane's start key in the
+    merged run∪delta view, in key order; rows past count are zeroed.
+    Per-row versions ride along so an OCC coordinator can validate a
+    scanned range like any other read set (FaSST OSDI'16 §4.3).
+    `delta_hits` counts rows served from the write-through overlay rather
+    than the sorted run — a freshness diagnostic (dintmon
+    scan_delta_hits), not part of the serial-order contract."""
+    key_hi: jax.Array   # u32 [R, SMAX]
+    key_lo: jax.Array   # u32 [R, SMAX]
+    ver: jax.Array      # u32 [R, SMAX]
+    val: jax.Array      # u32 [R, SMAX, VW]
+    count: jax.Array    # i32 [R]
+    delta_hits: jax.Array  # i32 [R]
 
 
 def make_batch(ops, keys, vals=None, vers=None, tables=None, width=None,

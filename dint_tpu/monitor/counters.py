@@ -143,6 +143,19 @@ _REGISTRY: tuple[tuple[str, str, str], ...] = (
      "waves. Summed over devices and a full run+drain it equals "
      "lock_requests — every prefetched lane is arbitrated exactly once; "
      "0 on unoverlapped routes"),
+    ("scan_requests", FLOW,
+     "dintscan: Op.SCAN lanes served by the store engine's ordered-run "
+     "path (stale-run RETRY lanes included — they consumed a request "
+     "slot even though they returned zero rows)"),
+    ("scan_rows", FLOW,
+     "dintscan: rows returned across all scan replies (sum of per-lane "
+     "counts; scan_rows <= scan_requests x scan_max by construction, "
+     "with equality iff every scan ran to its full requested length)"),
+    ("scan_delta_hits", FLOW,
+     "dintscan: scan reply rows served from the write-through delta "
+     "overlay rather than the sorted run (scan_delta_hits <= scan_rows; "
+     "0 in the step right after a drain-boundary rebuild — the overlay "
+     "freshness diagnostic)"),
 )
 
 ALL_NAMES: tuple[str, ...] = tuple(n for n, _, _ in _REGISTRY)
@@ -187,6 +200,9 @@ CTR_SERVE_OCC_LANES = COUNTER_INDEX["serve_occupancy_lanes"]
 CTR_SERVE_PAD_LANES = COUNTER_INDEX["serve_padded_lanes"]
 CTR_SERVE_SHED_LANES = COUNTER_INDEX["serve_shed_lanes"]
 CTR_ROUTE_PREFETCH_LANES = COUNTER_INDEX["route_prefetch_lanes"]
+CTR_SCAN_REQUESTS = COUNTER_INDEX["scan_requests"]
+CTR_SCAN_ROWS = COUNTER_INDEX["scan_rows"]
+CTR_SCAN_DELTA_HITS = COUNTER_INDEX["scan_delta_hits"]
 
 # the subset defined with IDENTICAL semantics by the dense engines and
 # the generic sort-based pipelines: on the parity workloads

@@ -256,6 +256,36 @@ _REGISTRY: tuple[tuple[str, str, str, str | None], ...] = (
      "double-buffered lock/read routing: cohort i+1's 2wL bucket "
      "exchange (ICI then host-aggregated DCN, same bytes as route) "
      "issued under cohort i's owner waves", "2*2*w*l*8"),
+    # --- dintscan (round 20): the store KV engine's waves. probe/install
+    # --- bytes are hash-layout-dependent (two-choice bucket walks,
+    # --- slot-scan gathers) — unmodeled, attribution-only. The scan pair
+    # --- IS modeled: locate is 2 u32 point gathers per lane per binary-
+    # --- search round (lg = ceil(log2 cap)); scan is the sequential slab
+    # --- — ROWS x ROW-BYTES (sl+dc window rows of 12+4vw B each), NOT
+    # --- lanes x point-gather bytes: that rows-not-probes shape is the
+    # --- scan's bandwidth claim, CI-gated by cost_budget's
+    # --- scan-dominance check ------------------------------------------
+    ("store", "probe",
+     "two-choice bucket probe: key compare over both candidate buckets' "
+     "slots + hit val/ver gathers — bytes hash-layout-dependent, "
+     "unmodeled", None),
+    ("store", "install",
+     "writer-election install/delete scatters (valid/key/val/ver) — "
+     "bytes hash-layout-dependent, unmodeled", None),
+    ("store", "scan_locate",
+     "ordered-run lower-bound: branchless meta binary search, 2 u32 "
+     "point gathers per lane per round over lg rounds", "w*lg*8"),
+    ("store", "scan",
+     "sequential window slab over the ordered run: per lane sl+dc "
+     "contiguous rows of (key_hi,key_lo,ver,val[vw]) = 12+4vw B/row, "
+     "one DMA stream per lane on the pallas route", "w*(sl+dc)*(12+4*vw)"),
+    ("store", "delta_append",
+     "write-through overlay append + latest-wins re-sort of the dc-row "
+     "delta — sort-bound, bytes unmodeled", None),
+    ("store", "run_rebuild",
+     "drain-boundary merge-compact of run∪delta back into a dense "
+     "sorted run (two stable sorts + gathers over cap+dc rows) — "
+     "sort-bound, bytes unmodeled", None),
 )
 
 
@@ -279,7 +309,7 @@ assert N_WAVES == len(set(ALL_WAVES)), "duplicate wave name in registry"
 
 def wave_bytes(name: str, **geometry) -> int | None:
     """Evaluate a wave's expected-bytes-per-step formula against run
-    geometry (w=, k=, l=, vw=, d=...). Returns None for compute-only
+    geometry (w=, k=, l=, vw=, d=, lg=, sl=, dc=...). Returns None for compute-only
     waves and for formulas whose variables the caller did not supply —
     attribution then reports time without a bandwidth figure instead of
     inventing one."""

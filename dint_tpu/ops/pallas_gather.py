@@ -147,6 +147,21 @@ def env_use_fused() -> bool:
     return os.environ.get("DINT_USE_FUSED", "0") not in ("", "0")
 
 
+def env_use_scan() -> bool:
+    return os.environ.get("DINT_USE_SCAN", "0") not in ("", "0")
+
+
+def resolve_use_scan(explicit: bool | None = None) -> bool:
+    """Engine-builder gate for the dintscan ordered-run scan path:
+    explicit kwarg wins, else the DINT_USE_SCAN env. No kernel probe here
+    — the scan path has a pure-XLA slab gather (scan_slab); whether the
+    streaming DMA kernel serves it rides the engine's use_pallas
+    resolution (scan_kernels_available), per the round-6/10 contract."""
+    if explicit is None:
+        return env_use_scan()
+    return bool(explicit)
+
+
 def resolve_use_hotset(explicit: bool | None = None) -> bool:
     """Engine-builder gate for the hot-set partition: explicit kwarg wins,
     else the DINT_USE_HOTSET env. No kernel probe here — the partition has
@@ -336,6 +351,124 @@ def hot_gather(tab, mirror, idx, midx, vw: int = 1,
                                midx.astype(I32), vw)
     return _xla_hot_gather(tab, mirror, idx.astype(I32),
                            midx.astype(I32), vw)
+
+
+# --------------------------------------------- dintscan sequential slabs
+
+
+def _scan_kernel(vw: int, lg: int, nslots: int, off_ref, order_ref,
+                 hi_ref, lo_ref, ver_ref, val_ref,
+                 ohi_ref, olo_ref, over_ref, oval_ref, sem):
+    """Sequential-slab gather over the ordered run: per lane, FOUR
+    contiguous-row DMAs (key_hi/key_lo/ver of `lg` rows + their `lg*vw`
+    val words) land the window [off, off+lg) into the lane's reply slab.
+    Lanes are walked in ascending-offset order (order_ref, prefetched) so
+    consecutive DMAs touch adjacent HBM — the sequential-bandwidth shape
+    the sorted layout exists for — while slabs land at each lane's
+    ORIGINAL index, keeping outputs order-independent and bit-identical
+    to the XLA slab gather. The ring keeps nslots lanes (4 DMAs each) in
+    flight; off must be in-bounds ([0, cap-lg], the engine's clamped
+    locate offsets) — a Pallas DMA from an out-of-range offset is
+    undefined, unlike XLA's clipping gather."""
+    k = off_ref.shape[0]
+
+    def copies(j):
+        lane = order_ref[j]
+        base = off_ref[lane]
+        s = jax.lax.rem(j, nslots)
+        return (
+            pltpu.make_async_copy(hi_ref.at[pl.ds(base, lg)],
+                                  ohi_ref.at[pl.ds(lane * lg, lg)],
+                                  sem.at[s, 0]),
+            pltpu.make_async_copy(lo_ref.at[pl.ds(base, lg)],
+                                  olo_ref.at[pl.ds(lane * lg, lg)],
+                                  sem.at[s, 1]),
+            pltpu.make_async_copy(ver_ref.at[pl.ds(base, lg)],
+                                  over_ref.at[pl.ds(lane * lg, lg)],
+                                  sem.at[s, 2]),
+            pltpu.make_async_copy(val_ref.at[pl.ds(base * vw, lg * vw)],
+                                  oval_ref.at[pl.ds(lane * lg * vw, lg * vw)],
+                                  sem.at[s, 3]),
+        )
+
+    def start(j):
+        for c in copies(j):
+            c.start()
+
+    def wait(j):
+        for c in copies(j):
+            c.wait()
+
+    def prime(j, _):
+        start(j)
+        return 0
+
+    jax.lax.fori_loop(0, min(nslots, k), prime, 0)
+
+    def body(j, _):
+        wait(j)
+
+        @pl.when(j + nslots < k)
+        def _():
+            start(j + nslots)
+
+        return 0
+
+    jax.lax.fori_loop(0, k, body, 0)
+
+
+@functools.partial(jax.jit, static_argnums=(6, 7, 8))
+def scan_rows(run_hi, run_lo, run_ver, run_val, off, order, lg: int,
+              vw: int, interpret: bool | None = None):
+    """K sequential windows of `lg` rows from the ordered run's flat
+    arrays. `order` is the lane walk order (ascending off); returns
+    (hi, lo, ver, val) slabs of shapes [K*lg] / [K*lg*vw], bit-identical
+    to the XLA slab gather for in-bounds off."""
+    if interpret is None:
+        interpret = use_interpret()
+    k = off.shape[0]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(1,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)] * 4,
+        out_specs=[pl.BlockSpec(memory_space=pltpu.ANY)] * 4,
+        scratch_shapes=[pltpu.SemaphoreType.DMA((NSLOTS, 4))],
+    )
+    return pl.pallas_call(
+        functools.partial(_scan_kernel, vw, lg, NSLOTS),
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((k * lg,), U32),
+                   jax.ShapeDtypeStruct((k * lg,), U32),
+                   jax.ShapeDtypeStruct((k * lg,), U32),
+                   jax.ShapeDtypeStruct((k * lg * vw,), U32)],
+        interpret=bool(interpret),
+    )(off.astype(I32), order.astype(I32), run_hi, run_lo, run_ver, run_val)
+
+
+def _xla_scan_slab(run_hi, run_lo, run_ver, run_val, off, lg: int, vw: int):
+    """The XLA fallback partition: per-lane dynamic-slice-shaped gathers
+    of the same contiguous windows. Costs random-gather issue rate where
+    the kernel streams, never correctness."""
+    idx = off[:, None] + jnp.arange(lg, dtype=I32)[None, :]
+    widx = (idx * vw)[:, :, None] + jnp.arange(vw, dtype=I32)[None, None, :]
+    return (run_hi[idx], run_lo[idx], run_ver[idx],
+            run_val[widx])
+
+
+def scan_slab(run_hi, run_lo, run_ver, run_val, off, lg: int, vw: int,
+              use_pallas: bool = False):
+    """Engine entry point for the dintscan window gather: the streaming
+    DMA kernel when the builder resolved pallas, the XLA slab gather
+    otherwise. Returns (hi, lo, ver [K, lg], val [K, lg, vw])."""
+    off = off.astype(I32)
+    k = off.shape[0]
+    if use_pallas:
+        order = jnp.argsort(off)
+        hi, lo, ver, val = scan_rows(run_hi, run_lo, run_ver, run_val,
+                                     off, order, lg, vw)
+        return (hi.reshape(k, lg), lo.reshape(k, lg), ver.reshape(k, lg),
+                val.reshape(k, lg, vw))
+    return _xla_scan_slab(run_hi, run_lo, run_ver, run_val, off, lg, vw)
 
 
 # ---------------------------------------------- hot-set fused install
@@ -1094,6 +1227,38 @@ def _probe_hot(n_idx: int, vw: int = 1) -> bool:
             raise RuntimeError("scatter_rows_hot output != XLA partition")
 
     return _probed(_probe_key("hot", n_idx, vw), probe)
+
+
+def _probe_scan(n_idx: int, lg: int, vw: int) -> bool:
+    """Compile + run scan_rows at the caller's lane geometry over a tiny
+    run and check it bit-for-bit against the XLA slab gather. Same
+    degrade contract as the other probes."""
+    def probe():
+        n = max(lg + 8, 64)
+        hi = jnp.arange(n, dtype=U32)
+        lo = hi * U32(3)
+        ver = hi + U32(100)
+        val = jnp.arange(n * vw, dtype=U32)
+        off = ((jnp.arange(n_idx, dtype=I32) * 7) % (n - lg))
+        order = jnp.argsort(off)
+        got = scan_rows(hi, lo, ver, val, off, order, lg, vw)
+        want = _xla_scan_slab(hi, lo, ver, val, off, lg, vw)
+        k = n_idx
+        got = (got[0].reshape(k, lg), got[1].reshape(k, lg),
+               got[2].reshape(k, lg), got[3].reshape(k, lg, vw))
+        for g, w in zip(got, want):
+            if not bool(jnp.array_equal(g, w)):
+                raise RuntimeError("scan_rows output != XLA slab gather")
+
+    return _probed(_probe_key("scan", n_idx, lg, vw), probe)
+
+
+def scan_kernels_available(n_idx: int = 512, lg: int = 16,
+                           vw: int = 4) -> bool:
+    """Availability probe for the dintscan streaming slab kernel. Same
+    degrade contract as kernels_available: False routes the scan path to
+    the XLA slab gather (bandwidth cost, never correctness)."""
+    return _probe_scan(n_idx, lg, vw)
 
 
 def kernels_available(n_idx: int = 512, m_lock: int | None = 64,

@@ -95,6 +95,11 @@ def cached_runner(engine: str, size: int, *, val_words: int = 4, **kw):
     if engine == "tatp_dense":
         from ..engines import tatp_dense as td
         out = td.build_pipelined_runner(size, val_words=val_words, **kw)
+    elif engine == "store":
+        # round-20 dintscan: the KV store as a first-class serve family
+        # (YCSB-E-shaped on-device cohorts, optional ordered-run scans)
+        from ..engines import store as st
+        out = st.build_serve_runner(size, val_words=val_words, **kw)
     elif engine == "multihost_sb":
         # the mesh serving plane (serve/mesh.py): kw carries the 2-D
         # mesh; the builder is itself memoized, this cache just keeps
@@ -145,7 +150,7 @@ class ServeEngine:
 
     # engine families this class can drive; subclasses (serve/mesh.py's
     # MeshServeEngine) narrow it to their own runner-builder path
-    ENGINES: tuple[str, ...] = ("tatp_dense", "smallbank_dense")
+    ENGINES: tuple[str, ...] = ("tatp_dense", "smallbank_dense", "store")
 
     def __init__(self, engine: str, size: int, *,
                  cfg: ControllerCfg | None = None,
@@ -253,6 +258,10 @@ class ServeEngine:
             from ..engines import tatp_dense as td
             return td.populate(np.random.default_rng(seed), self.size,
                                val_words=self.val_words)
+        if self.engine == "store":
+            from ..clients import micro
+            return micro.make_store_table(self.size,
+                                          val_words=self.val_words)
         from ..engines import smallbank_dense as sd
         return sd.create(self.size)
 

@@ -247,14 +247,49 @@ class HostKVS:
         return (bits.astype(np.uint64) * weights).sum(axis=1,
                                                       dtype=np.uint64)
 
-    def resolve_batch(self, ops, keys, vals):
+    def _live_keys(self) -> np.ndarray:
+        """All live keys, ascending — the host-side ordered view (round-20
+        dintscan). O(table) per call; scans through the cache tier are a
+        deferral path, not the bandwidth-bound fast path (that is the
+        authoritative store's OrderedRun)."""
+        ks = self._keys[self._used].astype(np.uint64)
+        if self._spill:
+            ks = np.r_[ks, np.fromiter(self._spill.keys(), np.uint64,
+                                       len(self._spill))]
+        return np.sort(ks)
+
+    def scan_batch(self, starts, lens, scan_max: int):
+        """Range scans against current state: per lane, the first
+        min(lens[i], scan_max) live keys >= starts[i] in key order.
+        Returns a per-lane list of (key, val tuple, ver) rows — the
+        oracle's row format (testing/oracle.StoreOracle.scan)."""
+        live = self._live_keys()
+        out = []
+        for s, want in zip(np.asarray(starts, np.uint64),
+                           np.asarray(lens, np.int64)):
+            k = max(0, min(int(want), scan_max))
+            i = np.searchsorted(live, s, side="left")
+            ks = live[i:i + k]
+            _, vals, vers = self.lookup(ks)
+            out.append([(int(kk), tuple(int(x) for x in v), int(r))
+                        for kk, v, r in zip(ks, vals, vers)])
+        return out
+
+    def resolve_batch(self, ops, keys, vals, scan_lens=None,
+                      scan_max: int = 0):
         """Serve the deferred lanes of one batch with the engine's
         serialization contract (engines/store.py header): per key, GETs see
         pre-batch state, then writes apply in lane order with monotonic
         versions. Deferral is whole-segment, so every lane of a deferred key
         is here — semantics compose exactly with the cache's local segments.
 
-        Returns (rtype [m], val [m, VW], ver [m])."""
+        Op.SCAN lanes (always deferred by the cache — see
+        store_cache.cache_step) resolve here too when ``scan_max`` > 0:
+        they sit in phase 1 with the GETs (pre-batch state), rtype VAL
+        with the row count in ver, and the return grows a 4th element —
+        the per-lane row lists of scan_batch.
+
+        Returns (rtype [m], val [m, VW], ver [m][, scans])."""
         ops = np.asarray(ops, np.int32)
         keys = np.asarray(keys, np.uint64)
         vals = np.asarray(vals, np.uint32)
@@ -262,19 +297,34 @@ class HostKVS:
         rtype = np.zeros(m, np.int32)
         rver = np.zeros(m, np.uint32)
         rval = np.zeros((m, self.vw), np.uint32)
+        scans: list[list] = [[] for _ in range(m)]
 
-        # GET phase: pre-batch state, fully vectorized
+        # GET/SCAN phase: pre-batch state, fully vectorized
         gi = np.nonzero(ops == Op.GET)[0]
         if len(gi):
             found, gv, gr = self.lookup(keys[gi])
             rtype[gi] = np.where(found, Reply.VAL, Reply.NOT_EXIST)
             rval[gi[found]] = gv[found]
             rver[gi] = np.where(found, gr, 0)
+        if scan_max > 0:
+            si = np.nonzero(ops == Op.SCAN)[0]
+            if len(si):
+                lens = (np.asarray(scan_lens)[si]
+                        if scan_lens is not None else np.zeros(len(si)))
+                rows = self.scan_batch(keys[si], lens, scan_max)
+                for i, rws in zip(si, rows):
+                    scans[i] = rws
+                rtype[si] = Reply.VAL
+                rver[si] = np.array([len(r) for r in rows], np.uint32)
+
+        def _done():
+            return (rtype, rval, rver, scans) if scan_max > 0 \
+                else (rtype, rval, rver)
 
         is_w = (ops == Op.SET) | (ops == Op.INSERT) | (ops == Op.DELETE)
         wi = np.nonzero(is_w)[0]
         if len(wi) == 0:
-            return rtype, rval, rver
+            return _done()
         order = np.argsort(keys[wi], kind="stable")
         sw = wi[order]                       # lanes in (key, arrival) order
         sk = keys[sw]
@@ -319,7 +369,7 @@ class HostKVS:
                 else:
                     gone = self.delete_batch(k)
                     rtype[i] = Reply.ACK if gone[0] else Reply.NOT_EXIST
-        return rtype, rval, rver
+        return _done()
 
 
 @dataclasses.dataclass
@@ -384,18 +434,33 @@ class CachedStore:
                                  np.asarray(rec["ver"])[mask])
         self.stats.writebacks += int(mask.sum())
 
-    def serve(self, ops, keys, vals=None):
+    def serve(self, ops, keys, vals=None, scan_lens=None,
+              scan_max: int = 0):
         """One server round: refill -> device step -> host fallback.
 
-        Returns (rtype [n], val [n, VW], ver [n]) numpy arrays.
+        Op.SCAN lanes always count as misses (the device cache holds an
+        unordered working-set subset; cache_step defers them wholesale)
+        and resolve host-side in resolve_batch's phase 1. With
+        ``scan_max`` > 0 the return grows a 4th element: per-lane scan
+        row lists (empty on non-scan lanes).
+
+        Returns (rtype [n], val [n, VW], ver [n][, scans]) numpy arrays.
         """
         n = len(ops)
         ops = np.asarray(ops, np.int32)
         keys = np.asarray(keys, np.uint64)
+        scans: list[list] = [[] for _ in range(n)]
         if vals is None:
             vals = np.zeros((n, self.vw), np.uint32)
 
         self._do_refills()
+        if scan_max > 0 and (ops == Op.SCAN).any():
+            # scan barrier: the host resolves scans against ITS view, so
+            # every dirty cached record (a write the backing store has
+            # not seen) must land first — else a range row is stale.
+            # Point deferrals don't need this (whole-segment deferral
+            # flushes the segment's own dirty copy); ranges cross keys.
+            self._flush_dirty()
         batch = make_batch(ops, keys, vals, width=self.width,
                            val_words=self.vw)
         self.cache, replies, miss, flush = self._step(self.cache, batch)
@@ -419,16 +484,44 @@ class CachedStore:
         # host fallback: resolve the deferred lanes as one sub-batch
         mi = np.nonzero(miss)[0]
         if len(mi):
-            rt, rv, rr = self.kvs.resolve_batch(ops[mi], keys[mi],
-                                                np.asarray(vals)[mi])
+            out = self.kvs.resolve_batch(
+                ops[mi], keys[mi], np.asarray(vals)[mi],
+                scan_lens=(np.asarray(scan_lens)[mi]
+                           if scan_lens is not None else None),
+                scan_max=scan_max)
+            rt, rv, rr = out[:3]
             rtype[mi], rver[mi] = rt, rr
             rval[mi] = rv
+            if scan_max > 0:
+                for i, rws in zip(mi, out[3]):
+                    scans[i] = rws
             # queue refills: full record for present keys, bloom-only after
             # DELETE / for absent keys (keeps negatives exact)
-            present = self.kvs.contains(keys[mi])
-            for k, p in zip(keys[mi], present):
+            # scan starts are range predicates, not cacheable point keys
+            pt = mi[ops[mi] != Op.SCAN]
+            for k, p in zip(keys[pt], self.kvs.contains(keys[pt])):
                 self._pending[int(k)] = bool(p)
+        if scan_max > 0:
+            return rtype, rval, rver, scans
         return rtype, rval, rver
+
+    def _flush_dirty(self):
+        """Write back EVERY dirty cached record (scan barrier): after
+        this the backing store's ordered view covers all committed
+        writes; the cached copies stay resident, now clean."""
+        from ..ops import u64
+        c = self.cache
+        t = c.kv
+        live = np.asarray(c.dirty) & np.asarray(t.valid)
+        e = np.nonzero(live)[0]
+        if len(e) == 0:
+            return
+        keys = u64.join(np.asarray(t.key_hi)[e], np.asarray(t.key_lo)[e])
+        vals = np.asarray(t.val).reshape(-1, t.val_words)[e]
+        vers = np.asarray(t.ver)[e]
+        self.kvs.writeback_batch(keys, vals, vers)
+        self.stats.writebacks += len(e)
+        self.cache = c.replace(dirty=jax.numpy.zeros_like(c.dirty))
 
     def _do_refills(self):
         if not self._pending:

@@ -24,11 +24,28 @@ class StoreOracle:
     def __init__(self):
         self.data: dict[int, tuple[tuple, int]] = {}   # key -> (val tuple, ver)
 
-    def step(self, ops, keys, vals):
+    def scan(self, start_key: int, scan_len: int):
+        """Range scan against pre-batch state: the first `scan_len` live
+        keys >= start_key in key order, as [(key, val tuple, ver), ...].
+        SCANs are reads — they sit in phase 1 with the GETs."""
+        rows = []
+        for k in sorted(self.data):
+            if len(rows) >= scan_len:
+                break
+            if k >= int(start_key):
+                rows.append((k, self.data[k][0], self.data[k][1]))
+        return rows
+
+    def step(self, ops, keys, vals, scan_lens=None, scan_max: int = 0):
+        """One batch. `scan_lens` [r] carries Op.SCAN lanes' requested row
+        counts (clipped to scan_max, the engine's static slab width).
+        Returns (rtype, rval, rver) — plus `scans`, a per-lane list of
+        scan row lists, when scan_max > 0."""
         r = len(ops)
         rtype = np.zeros(r, np.int32)
         rver = np.zeros(r, np.uint32)
         rval = np.zeros((r, np.asarray(vals).shape[1]), np.uint32)
+        scans: list[list] = [[] for _ in range(r)]
         # phase 1: reads against pre-state
         for i in range(r):
             if ops[i] == Op.GET:
@@ -39,6 +56,12 @@ class StoreOracle:
                     rtype[i] = Reply.VAL
                     rval[i] = ent[0]
                     rver[i] = ent[1]
+            elif ops[i] == Op.SCAN:
+                want = int(scan_lens[i]) if scan_lens is not None else 0
+                rows = self.scan(int(keys[i]), max(0, min(want, scan_max)))
+                scans[i] = rows
+                rtype[i] = Reply.VAL
+                rver[i] = np.uint32(len(rows))
         # phase 2: writes in lane order
         # version base = pre-batch version, recorded at the key's first write
         # in the batch; versions stay monotonic across delete+reinsert within
@@ -67,6 +90,8 @@ class StoreOracle:
                     rtype[i] = Reply.ACK
                 else:
                     rtype[i] = Reply.NOT_EXIST
+        if scan_max > 0:
+            return rtype, rval, rver, scans
         return rtype, rval, rver
 
 

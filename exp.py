@@ -761,7 +761,8 @@ def sweep_micro(window_s, quick, results, want=lambda name: True):
                 c = micro.StoreClient.populated(n_keys, width=w,
                                                 read_frac=read_frac)
                 return _timed_client(c, lambda: c.run_wave(rng),
-                                     window_s) | {"width": w}
+                                     window_s) | {"width": w,
+                                                  "scan": None}
 
             run_point(results, name, store_fn)
 
@@ -780,9 +781,41 @@ def sweep_micro(window_s, quick, results, want=lambda name: True):
             return _timed_client(c, lambda: c.run_wave(rng), window_s) | {
                 "width": w, "key_dist": "zipfian",
                 "zipf_theta": wl.ZIPF_THETA,
-                "use_hotset": c.use_hotset, "use_pallas": c.use_pallas}
+                "use_hotset": c.use_hotset, "use_pallas": c.use_pallas,
+                "scan": None}
 
         run_point(results, name, zipf_fn)
+
+    # round-20 dintscan: the scan-fraction ladder over the ordered run —
+    # YCSB-B shape (0%) through YCSB-E (95% scans) at one fixed width,
+    # Zipfian start keys, uniform lengths. Every artifact carries the
+    # "scan" object (or EXPLICIT null on the point-op rows above — same
+    # consumer contract as plan/counters): resolved routes + the mix, so
+    # the hw A/B behind PERF.md's round-20 decision rule is replayable.
+    scan_w = 1024 if quick else 4096
+    scan_max = 16 if quick else wl.YCSB_E_MAX_SCAN
+    for frac in (0.0, 0.05, 0.5, 0.95):
+        name = f"store_scan_f{int(frac * 100)}"
+        if not want(name):
+            continue
+
+        def scan_fn(frac=frac, w=scan_w, scan_max=scan_max):
+            c = micro.StoreClient.populated(
+                n_keys, width=w, read_frac=0.5, key_dist="zipfian",
+                use_scan=True, scan_frac=frac, scan_max=scan_max,
+                rebuild_every=1)
+            return _timed_client(c, lambda: c.run_wave(rng),
+                                 window_s) | {
+                "width": w, "key_dist": "zipfian",
+                "zipf_theta": wl.ZIPF_THETA,
+                "scan": {"use_scan": c.use_scan, "scan_frac": frac,
+                         "scan_max": scan_max,
+                         "max_scan_len": c.max_scan_len,
+                         "delta_cap": c.delta_cap,
+                         "rebuild_every": c.rebuild_every,
+                         "use_pallas": c.use_pallas}}
+
+        run_point(results, name, scan_fn)
 
     if any(want(n) for n in ("lock_2pl", "lock_fasst", "lock_fasst_attr")):
         trace = wl.lock_trace(rng, n_txns=200 if quick else 20_000,

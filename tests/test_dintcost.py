@@ -507,3 +507,28 @@ def test_prune_check_is_a_gate_scoped_dry_run(tmp_path, capsys):
     with pytest.raises(SystemExit):
         main(["check", "--prune-allowlist", "--target",
               "tatp_dense/block", "--allowlist", str(path)])
+
+
+def test_every_scan_target_beats_point_probes_per_row():
+    """The round-20 dintscan bandwidth claim, statically: every @scan
+    target's dint.store.scan wave must deliver reply rows STRICTLY
+    cheaper (HBM bytes/row) than its point twin's dint.store.probe
+    wave prices a probed reply (bytes/probe) — the same inequality the
+    standing scan-bytes-dominance gate enforces, pinned here with the
+    actual numbers so a silent geometry drift is loud."""
+    pairs = 0
+    for name, twin in sorted(T.TARGET_SCAN_TWIN.items()):
+        try:
+            ms, mt = cost.model_for(name), cost.model_for(twin)
+        except T.SkipTarget:
+            continue
+        assert not ms.error and not mt.error, (name, ms.error, mt.error)
+        geom = ms.geom or {}
+        w, sl = float(geom["w"]), float(geom["sl"])
+        scan_b = ms.wave_bytes_per_step().get("dint.store.scan", 0.0)
+        probe_b = mt.wave_bytes_per_step().get("dint.store.probe", 0.0)
+        assert scan_b > 0 and probe_b > 0, (name, scan_b, twin, probe_b)
+        per_row, per_probe = scan_b / (w * sl), probe_b / w
+        assert per_row < per_probe, (name, per_row, twin, per_probe)
+        pairs += 1
+    assert pairs >= 3     # block@scan, block@scan+pallas, serve@scan

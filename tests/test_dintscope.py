@@ -22,7 +22,8 @@ pytestmark = pytest.mark.scope
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 FIXTURE = os.path.join(REPO, "tests", "fixtures", "dintscope_trace.json")
-GEOM = {"w": 8192, "k": 4, "l": 3, "vw": 10, "d": 8}
+GEOM = {"w": 8192, "k": 4, "l": 3, "vw": 10, "d": 8,
+        "lg": 13, "sl": 8, "dc": 64}
 CLI = [sys.executable, os.path.join(REPO, "tools", "dintscope.py")]
 
 

@@ -416,6 +416,51 @@ def test_serve_engine_soak_reentrant_identities():
     assert len(rep["controller"]["switches"]) >= 2
 
 
+def test_serve_engine_store_scan_counters_reconcile():
+    """dintscan on the serve plane: the store engine family serves an
+    open-loop GET/SET/SCAN mix, the ordered run rebuilds at drain
+    boundaries, and the scan counter plane reconciles — every admitted
+    lane lands in the ledger and scan rows stay within the static slab
+    bound (scan_max x requests)."""
+    eng = ServeEngine("store", N_ACC,
+                      cfg=ControllerCfg(widths=(W,)),
+                      cohorts_per_block=CPB, clock=VirtualClock(),
+                      monitor=True, seed=3,
+                      runner_kw=dict(use_scan=True, scan_frac=0.5,
+                                     max_scan_len=6, scan_max=8,
+                                     read_frac=0.5))
+    rep = eng.run(poisson_schedule(80_000.0, 0.05, seed=5))
+    eng.close()
+    assert rep["offered"] == rep["admitted"] + rep["shed"]
+    assert rep["admitted"] > 0
+    c = rep["counters"]
+    assert c["serve_occupancy_lanes"] == rep["admitted"]
+    served = sum(int(w) * n for w, n in rep["steps_by_width"].items())
+    assert c["serve_occupancy_lanes"] + c["serve_padded_lanes"] == served
+    # the scan plane: ~half the admitted lanes issue Op.SCAN; replies
+    # carry at most scan_max rows each; overlay hits only on scanned rows
+    assert 0 < c["scan_requests"] < rep["admitted"]
+    assert 0 < c["scan_rows"] <= 8 * c["scan_requests"]
+    assert 0 <= c["scan_delta_hits"] <= c["scan_rows"]
+    # stale-scan RETRYs are the only non-committed admitted lanes
+    assert rep["committed"] <= rep["admitted"]
+
+
+def test_serve_engine_store_scan_off_has_silent_counters():
+    """use_scan=False: no run threaded, no scan counters bumped — the
+    default-off decision rule leaves the serve plane bit-identical to
+    the pre-dintscan store family."""
+    eng = ServeEngine("store", N_ACC, cfg=ControllerCfg(widths=(W,)),
+                      cohorts_per_block=CPB, clock=VirtualClock(),
+                      monitor=True, seed=3,
+                      runner_kw=dict(use_scan=False))
+    rep = eng.run(poisson_schedule(50_000.0, 0.04, seed=6))
+    eng.close()
+    assert rep["admitted"] > 0 and rep["committed"] == rep["admitted"]
+    c = rep["counters"]
+    assert c["scan_requests"] == c["scan_rows"] == c["scan_delta_hits"] == 0
+
+
 # ------------------------------------------------------------- shim pump
 
 

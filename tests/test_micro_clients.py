@@ -17,6 +17,42 @@ def test_store_client_mixes(rng):
         assert blk.p99_us >= blk.p50_us > 0
 
 
+def test_store_client_scan_mix(rng):
+    """YCSB-E-shaped wave through the scan-threaded step: every scan
+    lane answers VAL (run fresh each rebuild_every waves), counts obey
+    len/scan_max clipping (asserted inside run_wave), goodput counts
+    scan rows' lanes like any other reply."""
+    c = micro.StoreClient.populated(500, width=256, read_frac=0.5,
+                                    key_dist="zipfian", use_scan=True,
+                                    scan_frac=0.3, scan_max=8,
+                                    rebuild_every=2)
+    assert c.use_scan and c.scan_frac == 0.3
+    for _ in range(4):
+        ok = c.run_wave(rng, 256)
+        assert ok == 256
+    blk = c.rec.block(elapsed_s=1.0)
+    assert blk.goodput == 4 * 256
+
+
+def test_store_client_scan_stale_rebuilds_and_retries(rng):
+    """The in-doubt discipline: a stale overlay (tiny delta_cap, write-
+    heavy mix) makes scans RETRY; the client rebuilds the run mid-wave
+    and re-sends exactly those lanes, which must then answer VAL —
+    run_wave asserts the contract, we pin that the path actually ran."""
+    c = micro.StoreClient.populated(300, width=128, read_frac=0.0,
+                                    use_scan=True, scan_frac=0.5,
+                                    scan_max=4, delta_cap=4,
+                                    rebuild_every=10_000)
+    rebuilds = []
+    orig = c._rebuild
+    c._rebuild = lambda s: (rebuilds.append(1), orig(s))[1]
+    for _ in range(3):
+        c.run_wave(rng, 128)
+    # rebuild_every is effectively off: every rebuild here was the
+    # RETRY-recovery action
+    assert rebuilds, "stale overlay never exercised the retry path"
+
+
 def test_log_client(rng):
     c = micro.LogClient(width=256, lanes=4, capacity=1 << 10)
     for _ in range(4):

@@ -4,7 +4,7 @@ import pytest
 
 from dint_tpu.engines import store
 from dint_tpu.engines.types import Op, Reply, make_batch
-from dint_tpu.tables import kv
+from dint_tpu.tables import kv, run as run_mod
 from dint_tpu.testing.oracle import StoreOracle
 
 VW = 4
@@ -190,3 +190,192 @@ def test_insert_falls_back_to_alternate_bucket(rng):
     table, (rt, _, _) = run_step(table, [Op.INSERT],
                                  np.array([k3], np.uint64), rand_vals(rng, 1))
     assert list(rt) == [Reply.SPILL]
+
+
+# ------------------------------------------------------------- dintscan
+# Op.SCAN through step's run∪delta path: pre-batch serial order,
+# route bit-identity, the stale/RETRY contract, and the oracle
+# differential on adversarial mixed batches.
+
+SMAX = 8
+DCAP = 8
+
+
+def scan_step(table, run, ops, keys, vals, scan_lens, scan_max=SMAX,
+              width=None, use_pallas=False):
+    batch = make_batch(ops, keys, vals,
+                       vers=np.asarray(scan_lens, np.uint32),
+                       width=width or len(ops), val_words=VW)
+    step = jax.jit(store.step, static_argnames=(
+        "maintain_bloom", "use_pallas", "scan_max"))
+    table, rep, run, srep = step(table, batch, use_pallas=use_pallas,
+                                 run=run, scan_max=scan_max)
+    return table, run, rep, srep
+
+
+def srep_rows(srep, lane):
+    """Device scan reply for one lane as the oracle's row list."""
+    c = int(np.asarray(srep.count)[lane])
+    lo = np.asarray(srep.key_lo)[lane]
+    hi = np.asarray(srep.key_hi)[lane].astype(np.uint64)
+    ver = np.asarray(srep.ver)[lane]
+    val = np.asarray(srep.val)[lane]
+    return [(int((hi[j] << 32) | lo[j]),
+             tuple(int(x) for x in val[j]), int(ver[j]))
+            for j in range(c)]
+
+
+def test_scan_sees_pre_batch_state(rng):
+    table = kv.create(1 << 6, slots=8, val_words=VW)
+    vals0 = rand_vals(rng, 3)
+    table = kv.populate(table, np.array([10, 20, 30], np.uint64), vals0)
+    run = run_mod.from_table(table, delta_cap=DCAP)
+    v = rand_vals(rng, 2)
+    # SET 15 rides in the SAME batch: the scan must NOT see it (scans
+    # are phase-1 reads — a valid serial order puts them with the GETs)
+    table, run, rep, srep = scan_step(
+        table, run, [Op.SET, Op.SCAN], np.array([15, 10], np.uint64),
+        v, [0, 3])
+    rt = np.asarray(rep.rtype)
+    assert rt[1] == Reply.VAL
+    assert int(np.asarray(rep.ver)[1]) == 3
+    assert [r[0] for r in srep_rows(srep, 1)] == [10, 20, 30]
+    # ...and the NEXT batch's scan sees the install, via the overlay
+    table, run, rep, srep = scan_step(
+        table, run, [Op.SCAN], np.array([10], np.uint64),
+        rand_vals(rng, 1), [4])
+    rows = srep_rows(srep, 0)
+    assert [r[0] for r in rows] == [10, 15, 20, 30]
+    assert rows[1][1] == tuple(int(x) for x in v[0])
+    # scan lanes carry rows in the slab, never in the point-reply val
+    assert (np.asarray(rep.val)[0] == 0).all()
+
+
+def test_scan_differential_vs_oracle(rng):
+    """Adversarial mixed batches: SCAN lanes straddling same-batch
+    SET/INSERT/DELETE writes to the scanned range, reply-for-reply
+    against the sequential oracle, run rebuilt at every drain boundary
+    (keyspace <= 40: the oracle does not model SPILL)."""
+    table = kv.create(1 << 6, slots=8, val_words=VW)
+    oracle = StoreOracle()
+    run = run_mod.from_table(table, delta_cap=DCAP)
+    keyspace, n = 40, 24
+    for it in range(12):
+        ops = rng.choice(
+            [Op.GET, Op.SET, Op.INSERT, Op.DELETE, Op.SCAN, Op.NOP],
+            size=n, p=[0.2, 0.2, 0.05, 0.15, 0.3, 0.1]).astype(np.int32)
+        keys = rng.integers(0, keyspace, size=n).astype(np.uint64)
+        vals = rand_vals(rng, n)
+        lens = np.where(ops == Op.SCAN,
+                        rng.integers(0, SMAX + 1, size=n), 0)
+        table, run, rep, srep = scan_step(table, run, ops, keys, vals,
+                                          lens, use_pallas=bool(it % 2))
+        rt = np.asarray(rep.rtype)[:n]
+        rver = np.asarray(rep.ver)[:n]
+        ot, ov, over, oscans = oracle.step(ops, keys, vals,
+                                           scan_lens=lens, scan_max=SMAX)
+        assert np.array_equal(rt, ot), (it, rt, ot)
+        assert np.array_equal(rver, over), it
+        for i in np.nonzero(ops == Op.SCAN)[0]:
+            assert srep_rows(srep, i) == oscans[i], (it, i, keys[i])
+        # drain boundary: fold the overlay before the overlay overflows
+        run = store.rebuild_run(table, run)
+        assert run_mod.to_items(run) == oracle.data
+        assert kv.to_dict(table) == oracle.data
+
+
+def test_scan_never_sees_spilled_insert(rng):
+    """A SPILLed insert lands NOWHERE — not the table, not the overlay:
+    a later scan over its range must skip it (the same fixup that keeps
+    replies honest keeps the run honest)."""
+    from dint_tpu.ops import hashing
+    ks = np.arange(1, 4000, dtype=np.uint64)
+    b1, b2 = hashing.bucket_pair_np(ks, 4)
+    cands = ks[(b1 == 0) & (b2 == 1)]
+    assert len(cands) >= 3
+    k1, k2, k3 = (int(x) for x in cands[:3])
+    table = kv.create(4, slots=1, val_words=VW)       # ne=4 >= 2+2
+    run = run_mod.from_table(table, delta_cap=2)
+    v = rand_vals(rng, 4)
+    # k3's both buckets are full after k1/k2 land -> SPILL, same batch
+    table, run, rep, srep = scan_step(
+        table, run, [Op.INSERT, Op.INSERT, Op.INSERT, Op.SCAN],
+        np.array([k1, k2, k3, 0], np.uint64), v, [0, 0, 0, 2],
+        scan_max=2)
+    rt = np.asarray(rep.rtype)
+    assert list(rt[:3]) == [Reply.ACK, Reply.ACK, Reply.SPILL]
+    assert srep_rows(srep, 3) == []                   # pre-batch: empty
+    table, run, rep, srep = scan_step(
+        table, run, [Op.SCAN], np.array([0], np.uint64),
+        rand_vals(rng, 1), [2], scan_max=2)
+    got = [r[0] for r in srep_rows(srep, 0)]
+    assert got == sorted((k1, k2))[:2] and k3 not in got
+    assert k3 not in run_mod.to_items(run)
+
+
+def test_scan_three_routes_bit_identical(rng):
+    """Acceptance: identical ScanReplies from (a) the XLA slab-gather
+    fallback, (b) the pallas scan_rows kernel, and (c) the XLA route
+    after a drain-boundary rebuild_run folded the overlay."""
+    table = kv.create(1 << 6, slots=8, val_words=VW)
+    keys = rng.choice(40, size=25, replace=False).astype(np.uint64)
+    table = kv.populate(table, keys, rand_vals(rng, 25))
+    run = run_mod.from_table(table, delta_cap=DCAP)
+    # populate the overlay: writes + a delete through the scan-threaded
+    # step (effective-writer lanes are what delta_append receives)
+    wops = [Op.SET, Op.SET, Op.INSERT, Op.DELETE]
+    wkeys = np.array([keys[0], keys[1], 41, keys[2]], np.uint64)
+    table, run, _, _ = scan_step(table, run, wops, wkeys,
+                                 rand_vals(rng, 4), [0, 0, 0, 0])
+    assert int(run.d_n) > 0
+    sops = [Op.SCAN] * 6
+    starts = np.array([0, 5, 17, 38, 41, 100], np.uint64)
+    lens = np.array([SMAX, 3, 5, SMAX, 1, 4])
+    svals = rand_vals(rng, 6)
+
+    def answer(t, rn, use_pallas):
+        _, _, rep, srep = scan_step(t, rn, sops, starts, svals, lens,
+                                    use_pallas=use_pallas)
+        return rep, srep
+
+    rep_a, srep_a = answer(table, run, False)
+    rep_b, srep_b = answer(table, run, True)
+    rebuilt = store.rebuild_run(table, run)
+    assert int(rebuilt.d_n) == 0
+    rep_c, srep_c = answer(table, rebuilt, False)
+    for rep, srep in ((rep_b, srep_b), (rep_c, srep_c)):
+        assert np.array_equal(np.asarray(rep.rtype),
+                              np.asarray(rep_a.rtype))
+        assert np.array_equal(np.asarray(rep.ver), np.asarray(rep_a.ver))
+        for f in ("key_hi", "key_lo", "ver", "val", "count"):
+            assert np.array_equal(np.asarray(getattr(srep, f)),
+                                  np.asarray(getattr(srep_a, f))), f
+    # the overlay-pending routes served rows from the delta...
+    assert int(np.asarray(srep_a.delta_hits).sum()) > 0
+    # ...and the rebuilt run serves the same rows from the dense run
+    assert int(np.asarray(srep_c.delta_hits).sum()) == 0
+
+
+def test_scan_stale_overlay_replies_retry_until_rebuild(rng):
+    table = kv.create(1 << 6, slots=8, val_words=VW)
+    table = kv.populate(table, np.arange(1, 9, dtype=np.uint64),
+                        rand_vals(rng, 8))
+    run = run_mod.from_table(table, delta_cap=2)
+    # 4 distinct-key writes overflow the 2-entry overlay -> stale
+    table, run, _, _ = scan_step(
+        table, run, [Op.SET] * 4, np.array([1, 2, 3, 4], np.uint64),
+        rand_vals(rng, 4), [0] * 4, scan_max=2)
+    assert bool(np.asarray(run.stale))
+    table, run, rep, srep = scan_step(
+        table, run, [Op.SCAN], np.array([1], np.uint64),
+        rand_vals(rng, 1), [2], scan_max=2)
+    assert int(np.asarray(rep.rtype)[0]) == Reply.RETRY
+    assert int(np.asarray(srep.count)[0]) == 0        # stale: no rows
+    # drain-boundary refresh re-snapshots; the retry answers VAL
+    run = store.rebuild_run(table, run)
+    assert not bool(np.asarray(run.stale))
+    table, run, rep, srep = scan_step(
+        table, run, [Op.SCAN], np.array([1], np.uint64),
+        rand_vals(rng, 1), [2], scan_max=2)
+    assert int(np.asarray(rep.rtype)[0]) == Reply.VAL
+    assert [r[0] for r in srep_rows(srep, 0)] == [1, 2]

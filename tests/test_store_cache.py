@@ -98,3 +98,50 @@ def test_write_through_set_invalidates(rng):
                            np.array([5], np.uint64))
     assert rt[0] == Reply.VAL and rv[0, 0] == 9 and rr[0] == 2
     assert srv.stats.misses == m0 + 1
+
+
+@pytest.mark.parametrize("policy", store_cache.POLICIES)
+def test_scan_mix_matches_oracle(policy, rng):
+    """dintscan through the two-tier server: Op.SCAN lanes resolve
+    host-side against the authoritative KVS (ranges aren't cacheable
+    point keys), so every dirty cached record must be written back
+    BEFORE the scan answers — reply-for-reply against the oracle over
+    mixed GET/SET/INSERT/DELETE/SCAN batches, per policy."""
+    scan_max = 6
+    srv = CachedStore(8, val_words=VW, policy=policy, width=128)
+    oracle = StoreOracle()
+    keys0 = np.arange(1, 30, dtype=np.uint64)
+    vals0 = rng.integers(1, 99, size=(len(keys0), VW)).astype(np.uint32)
+    srv.populate(keys0, vals0)
+    oracle.step(np.full(len(keys0), Op.INSERT, np.int32), keys0, vals0)
+
+    n, keyspace = 96, 60
+    saw_scan_after_dirty = False
+    for _ in range(12):
+        ops = rng.choice([Op.GET, Op.GET, Op.SET, Op.SET, Op.INSERT,
+                          Op.DELETE, Op.SCAN, Op.SCAN],
+                         size=n).astype(np.int32)
+        keys = rng.integers(1, keyspace, size=n).astype(np.uint64)
+        vals = rng.integers(1, 99, size=(n, VW)).astype(np.uint32)
+        lens = np.where(ops == Op.SCAN,
+                        rng.integers(0, scan_max + 1, size=n),
+                        0).astype(np.uint32)
+        saw_scan_after_dirty |= bool(
+            np.asarray(srv.cache.dirty).any() and (ops == Op.SCAN).any())
+        rt, rv, rr, scans = srv.serve(ops, keys, vals, scan_lens=lens,
+                                      scan_max=scan_max)
+        ort, orv, orr, oscans = oracle.step(ops, keys, vals,
+                                            scan_lens=lens,
+                                            scan_max=scan_max)
+        np.testing.assert_array_equal(rt, ort, err_msg=f"rtype {policy}")
+        np.testing.assert_array_equal(rr, orr, err_msg=f"ver {policy}")
+        isval = (ort == Reply.VAL) & (ops != Op.SCAN)
+        np.testing.assert_array_equal(rv[isval], orv[isval],
+                                      err_msg=f"val {policy}")
+        for i in np.nonzero(ops == Op.SCAN)[0]:
+            assert scans[i] == oscans[i], (policy, i, keys[i])
+    if policy != store_cache.WT:
+        # WT never holds dirty records; the WB policies must have hit
+        # the scan barrier (dirty cache + scan in one batch) for this
+        # test to mean anything
+        assert saw_scan_after_dirty

@@ -17,7 +17,7 @@ import numpy as np
 from dint_tpu.engines import (fasst, lock2pl, logsrv, store,
                               smallbank_dense as sd, tatp_dense as td)
 from dint_tpu.engines.types import Op, Reply, make_batch
-from dint_tpu.tables import kv, log as logring
+from dint_tpu.tables import kv, log as logring, run as run_mod
 
 rng = np.random.default_rng(0)
 R = 4096
@@ -72,6 +72,85 @@ table, rep = step(table, make_batch(
     np.full(4, np.uint64(10**9)), width=4, val_words=10))
 check("delete of nonexistent NOT_EXIST",
       (np.asarray(rep.rtype)[:1] == Reply.NOT_EXIST).all())
+
+# ---- 1b. dintscan: Op.SCAN over the ordered run (run union delta) ------
+SMAX = 8
+srun = run_mod.from_table(table, delta_cap=64)
+sstep = jax.jit(store.step, static_argnames=("maintain_bloom",
+                                             "use_pallas", "scan_max"))
+n_scan = 64
+s_ops = np.full(R, Op.NOP, np.int32)
+s_ops[:n_scan] = Op.SCAN
+s_keys = np.zeros(R, np.uint64)
+s_keys[:n_scan] = rng.integers(1, n_keys - SMAX, n_scan)
+s_lens = np.zeros(R, np.uint32)
+s_lens[:n_scan] = rng.integers(1, SMAX + 1, n_scan)
+sb_scan = make_batch(s_ops, s_keys, wv, vers=s_lens, width=R, val_words=10)
+_, rep, srun, srep = sstep(table, sb_scan, run=srun, scan_max=SMAX)
+rt = np.asarray(rep.rtype)[:n_scan]
+cnt = np.asarray(srep.count)[:n_scan]
+khi = np.asarray(srep.key_hi).astype(np.uint64)
+klo = np.asarray(srep.key_lo).astype(np.uint64)
+sval = np.asarray(srep.val)
+ok_rows = True
+for i in range(n_scan):
+    L = int(s_lens[i])
+    keys_got = ((khi[i] << np.uint64(32)) | klo[i])[:cnt[i]]
+    # keyspace 1..n_keys is dense, so an L-row scan from k is k..k+L-1
+    want = np.arange(s_keys[i], s_keys[i] + L, dtype=np.uint64)
+    ok_rows &= cnt[i] == L and np.array_equal(keys_got, want) \
+        and (sval[i, :L, 1] == MAGIC).all()
+check("scan lanes return the dense key range with populate magic",
+      ok_rows and (rt == Reply.VAL).all()
+      and np.array_equal(np.asarray(rep.ver)[:n_scan], cnt))
+
+# route identity: XLA slab gather vs pallas scan_rows kernel, then the
+# XLA route again after a merge-compact rebuild — all three bit-equal
+def srep_tuple(r):
+    return tuple(np.asarray(x) for x in
+                 (r.count, r.key_hi, r.key_lo, r.ver, r.val))
+_, _, _, srep_p = sstep(table, sb_scan, run=srun, scan_max=SMAX,
+                        use_pallas=True)
+srun_rb = store.rebuild_run(table, srun)
+_, _, _, srep_rb = sstep(table, sb_scan, run=srun_rb, scan_max=SMAX)
+check("scan replies bit-identical: XLA vs pallas vs post-rebuild",
+      all(np.array_equal(a, b) and np.array_equal(a, c)
+          for a, b, c in zip(srep_tuple(srep), srep_tuple(srep_p),
+                             srep_tuple(srep_rb))))
+
+# write-through overlay: a SET in one batch is visible to the NEXT
+# batch's scan (run union delta view), without a rebuild
+probe = np.uint64(s_keys[0])
+w_ops = np.full(R, Op.NOP, np.int32)
+w_ops[0] = Op.SET
+w_keys = np.zeros(R, np.uint64)
+w_keys[0] = probe
+w_vals = np.zeros((R, 10), np.uint32)
+w_vals[0, 2] = 0xBEEF
+table2, _, srun, _ = sstep(table, make_batch(w_ops, w_keys, w_vals,
+                                             width=R, val_words=10),
+                           run=srun, scan_max=SMAX)
+_, _, srun, srep_d = sstep(table2, sb_scan, run=srun, scan_max=SMAX)
+check("scan sees prior-batch SET through the delta overlay",
+      int(np.asarray(srep_d.count)[0]) >= 1
+      and int(np.asarray(srep_d.val)[0, 0, 2]) == 0xBEEF
+      and int(np.asarray(srep_d.delta_hits)[0]) >= 1)
+
+# stale contract: overflow the 64-row overlay -> scans reply RETRY with
+# zero rows; rebuild_run re-snapshots and the same scan serves VAL again
+ov_keys = rng.choice(np.arange(1, n_keys + 1, dtype=np.uint64), 512,
+                     replace=False)
+ov = make_batch(np.full(512, Op.SET, np.int32), ov_keys, wv[:512],
+                width=512, val_words=10)
+table2, _, srun, _ = sstep(table2, ov, run=srun, scan_max=SMAX)
+_, rep_st, srun, srep_st = sstep(table2, sb_scan, run=srun, scan_max=SMAX)
+srun = store.rebuild_run(table2, srun)
+_, rep_ok, _, _ = sstep(table2, sb_scan, run=srun, scan_max=SMAX)
+check("stale overlay -> RETRY, rebuild_run -> VAL",
+      bool(np.asarray(srun.stale) == False)  # noqa: E712
+      and (np.asarray(rep_st.rtype)[:n_scan] == Reply.RETRY).all()
+      and (np.asarray(srep_st.count)[:n_scan] == 0).all()
+      and (np.asarray(rep_ok.rtype)[:n_scan] == Reply.VAL).all())
 
 # ---- 2. lock2pl / fasst / logsrv ---------------------------------------
 from dint_tpu.tables import locks
